@@ -1,0 +1,28 @@
+"""gansformer_tpu — a TPU-native (JAX/XLA/Pallas) GANsformer framework.
+
+A from-scratch re-design of the capability surface of
+GiorgiaAuroraAdorni/gansformer-reproducibility-challenge (StyleGAN2-based
+Generative Adversarial Transformers, TF1/CUDA lineage) for TPU hardware:
+
+- ``ops``      — the compute primitives that replace the reference's custom
+                 CUDA kernels (upfirdn2d, fused_bias_act, modulated conv,
+                 bipartite attention), expressed as XLA-fusable jnp/lax
+                 composites with optional Pallas TPU kernels.
+- ``models``   — Flax generator (mapping + attention-augmented synthesis) and
+                 discriminator.
+- ``losses``   — non-saturating logistic GAN loss, R1, path-length reg.
+- ``train``    — two-timescale G/D training engine with lazy regularization,
+                 EMA generator, orbax checkpointing.
+- ``parallel`` — device mesh / sharding layer (the NCCL all-reduce of the
+                 reference becomes XLA collectives over ICI/DCN).
+- ``data``     — record IO + dataset pipeline.
+- ``metrics``  — on-device FID / Inception Score evaluator.
+- ``cli``      — train / generate / evaluate entrypoints.
+
+(Subpackages land incrementally; see the repo README for current status.)
+
+Reference lineage is documented per-module via ``src/<path>`` citations into
+the upstream layout reconstructed in /root/repo/SURVEY.md.
+"""
+
+__version__ = "0.1.0"

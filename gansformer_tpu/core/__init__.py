@@ -1,0 +1,9 @@
+from gansformer_tpu.core.config import (
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+    ExperimentConfig,
+    PRESETS,
+    get_preset,
+)

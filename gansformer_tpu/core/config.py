@@ -1,0 +1,263 @@
+"""Typed configuration layer.
+
+The reference passes configuration as nested ``dnnlib.EasyDict`` objects built
+by argparse in ``src/train.py`` and consumed as ``**kwargs`` by
+``src/training/training_loop.py`` (SURVEY.md §5 "Config / flag system", T2).
+Here that becomes frozen dataclasses — one per layer of the stack — plus named
+presets mirroring the five driver benchmark configs at
+/root/repo/BASELINE.json:7-11.  Everything is hashable/static so configs can be
+closed over by ``jax.jit`` without retracing surprises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Generator + discriminator architecture.
+
+    Mirrors the capability surface of the reference's ``src/training/network.py``
+    (G_GANsformer / D_GANsformer; SURVEY.md §2.3): StyleGAN2 skeleton with
+    bipartite (simplex/duplex) attention between k latent components and the
+    image feature grid.
+    """
+
+    resolution: int = 256
+    img_channels: int = 3
+
+    # --- latents -----------------------------------------------------------
+    # k latent components attend to the image grid; one additional *global*
+    # component (when use_global) drives the per-layer conv styles, matching
+    # the reference's global latent that carries StyleGAN2-style modulation.
+    components: int = 16
+    latent_dim: int = 512
+    w_dim: int = 512
+    use_global: bool = True
+
+    # --- mapping network ---------------------------------------------------
+    mapping_layers: int = 8
+    mapping_dim: int = 512
+    mapping_lrmul: float = 0.01
+
+    # --- synthesis ---------------------------------------------------------
+    fmap_base: int = 16384
+    fmap_max: int = 512
+    fmap_min: int = 1
+    # 'none' | 'simplex' | 'duplex'  (SURVEY.md §2.3)
+    attention: str = "duplex"
+    # Bipartite attention is applied at block resolutions 4..attn_max_res
+    # (cost is O(n*k), n = H*W — linear in pixels, the GANsformer scaling
+    # property to preserve; SURVEY.md §5 "Long-context").
+    attn_start_res: int = 8
+    attn_max_res: int = 128
+    num_heads: int = 1
+    # 'add' | 'mul' | 'both' — how attention output updates the grid features.
+    integration: str = "both"
+    pos_encoding: str = "sinusoidal"  # 'sinusoidal' | 'learned' | 'none'
+    # Duplex: latents first update themselves from the grid (k-means-like
+    # centroid step), then the grid attends back.
+    kmeans_iters: int = 1
+
+    # --- discriminator -----------------------------------------------------
+    mbstd_group_size: int = 4
+    mbstd_num_features: int = 1
+    d_attention: bool = False
+    d_components: int = 16  # learned query vectors when d_attention
+
+    # --- numerics ----------------------------------------------------------
+    # Compute dtype for conv/matmul-heavy paths; params stay fp32.
+    dtype: str = "float32"  # 'float32' | 'bfloat16'
+    blur_filter: Tuple[int, ...] = (1, 3, 3, 1)
+
+    @property
+    def resolution_log2(self) -> int:
+        r = self.resolution.bit_length() - 1
+        assert self.resolution == 2**r and self.resolution >= 4
+        return r
+
+    @property
+    def num_ws(self) -> int:
+        """Total latent components fed to mapping (k + optional global)."""
+        return self.components + (1 if self.use_global else 0)
+
+    def nf(self, res: int) -> int:
+        """Feature maps at a given block resolution (StyleGAN2 fmap schedule)."""
+        stage = res.bit_length() - 1  # log2(res)
+        return int(min(max(self.fmap_base // (2**stage), self.fmap_min), self.fmap_max))
+
+    @property
+    def block_resolutions(self) -> Tuple[int, ...]:
+        return tuple(2**i for i in range(2, self.resolution_log2 + 1))
+
+    def attn_resolutions(self) -> Tuple[int, ...]:
+        if self.attention == "none":
+            return ()
+        return tuple(
+            r
+            for r in self.block_resolutions
+            if self.attn_start_res <= r <= self.attn_max_res
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training dynamics — two-timescale G/D with lazy regularization.
+
+    Capability parity with the reference's ``src/training/training_loop.py`` +
+    ``src/training/loss.py`` (SURVEY.md §2.2): alternating G/D Adam steps,
+    lazy R1 on D every ``d_reg_interval`` steps, lazy path-length on G every
+    ``g_reg_interval`` steps, EMA generator with ~10k-img half-life.
+    """
+
+    batch_size: int = 32            # global batch across the data mesh axis
+    total_kimg: int = 25000
+    g_lr: float = 2e-3
+    d_lr: float = 2e-3
+    adam_beta1: float = 0.0
+    adam_beta2: float = 0.99
+    adam_eps: float = 1e-8
+
+    r1_gamma: float = 10.0
+    d_reg_interval: int = 16
+    g_reg_interval: int = 4
+    pl_weight: float = 2.0
+    pl_decay: float = 0.01
+    pl_batch_shrink: int = 2
+    style_mixing_prob: float = 0.9
+
+    ema_kimg: float = 10.0
+    ema_rampup: Optional[float] = None
+
+    # cadence (ticks are the reference's unit of logging/checkpointing)
+    kimg_per_tick: int = 4
+    snapshot_ticks: int = 10
+    image_snapshot_ticks: int = 10
+    metric_ticks: int = 50
+
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset pipeline config (reference: src/training/dataset.py, SURVEY §2.2)."""
+
+    name: str = "synthetic"
+    path: Optional[str] = None      # directory of records / images
+    resolution: int = 256
+    channels: int = 3
+    # 'synthetic' generates deterministic smooth images for smoke tests,
+    # 'tfrecord' reads the reference's multi-resolution TFRecord format,
+    # 'npz' reads a packed numpy archive.
+    source: str = "synthetic"
+    shuffle_buffer: int = 4096
+    prefetch: int = 2
+    mirror_augment: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh layout.
+
+    The reference's distribution story is in-graph GPU towers + NCCL
+    all-reduce (SURVEY.md §2.4).  Here the whole backend collapses to a
+    ``jax.sharding.Mesh`` with named axes; gradients ride XLA ``psum`` over
+    ICI/DCN.  ``data`` is the only axis the GANsformer workload needs; a
+    ``model`` axis hook is kept for forward-compatibility.
+    """
+
+    data: int = -1   # -1: use all visible devices
+    model: int = 1
+    # multi-host process group (jax.distributed.initialize) parameters
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    def axis_sizes(self, n_devices: int) -> Tuple[int, int]:
+        data = self.data if self.data > 0 else max(1, n_devices // self.model)
+        return (data, self.model)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    name: str = "default"
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_json(s: str) -> "ExperimentConfig":
+        d = json.loads(s)
+        return ExperimentConfig(
+            name=d["name"],
+            model=ModelConfig(**{k: tuple(v) if isinstance(v, list) else v
+                                 for k, v in d["model"].items()}),
+            train=TrainConfig(**d["train"]),
+            data=DataConfig(**d["data"]),
+            mesh=MeshConfig(**d["mesh"]),
+        )
+
+
+def _preset(name, model, train, data) -> ExperimentConfig:
+    return ExperimentConfig(name=name, model=model, train=train, data=data)
+
+
+# The five driver benchmark configs (/root/repo/BASELINE.json:7-11).
+PRESETS = {
+    # 1. CLEVR 64×64, Simplex, k=8, batch=4 — single-process CPU smoke.
+    "clevr64-simplex": _preset(
+        "clevr64-simplex",
+        ModelConfig(resolution=64, components=8, attention="simplex",
+                    attn_max_res=32, fmap_base=2048, fmap_max=256,
+                    latent_dim=128, w_dim=128, mapping_dim=128,
+                    mapping_layers=4),
+        TrainConfig(batch_size=4, total_kimg=100, kimg_per_tick=1,
+                    r1_gamma=1.0),
+        DataConfig(name="clevr", resolution=64, source="synthetic"),
+    ),
+    # 2. FFHQ 256×256, Duplex, k=16 — paper headline config (north star).
+    "ffhq256-duplex": _preset(
+        "ffhq256-duplex",
+        ModelConfig(resolution=256, components=16, attention="duplex",
+                    attn_max_res=128, dtype="bfloat16"),
+        TrainConfig(batch_size=32, total_kimg=25000, r1_gamma=10.0),
+        DataConfig(name="ffhq", resolution=256, source="tfrecord"),
+    ),
+    # 3. LSUN-Bedroom 256×256, Duplex, k=16.
+    "bedroom256-duplex": _preset(
+        "bedroom256-duplex",
+        ModelConfig(resolution=256, components=16, attention="duplex",
+                    attn_max_res=128, dtype="bfloat16"),
+        TrainConfig(batch_size=32, total_kimg=25000, r1_gamma=100.0),
+        DataConfig(name="lsun-bedroom", resolution=256, source="tfrecord"),
+    ),
+    # 4. Cityscapes 256×256, Duplex, k=32 (compositional scenes).
+    "cityscapes256-duplex": _preset(
+        "cityscapes256-duplex",
+        ModelConfig(resolution=256, components=32, attention="duplex",
+                    attn_max_res=128, dtype="bfloat16"),
+        TrainConfig(batch_size=32, total_kimg=25000, r1_gamma=20.0),
+        DataConfig(name="cityscapes", resolution=256, source="tfrecord"),
+    ),
+    # 5. FFHQ 1024×1024, Duplex — data-parallel across a v4-32 ICI mesh.
+    "ffhq1024-duplex": _preset(
+        "ffhq1024-duplex",
+        ModelConfig(resolution=1024, components=16, attention="duplex",
+                    attn_max_res=128, dtype="bfloat16"),
+        TrainConfig(batch_size=32, total_kimg=25000, r1_gamma=32.0),
+        DataConfig(name="ffhq", resolution=1024, source="tfrecord"),
+    ),
+}
+
+
+def get_preset(name: str) -> ExperimentConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]

@@ -1,0 +1,48 @@
+"""PRNG-key plumbing.
+
+The reference seeds a global TF1 graph RNG once in ``tflib.init_tf`` (SURVEY.md
+§2.2 "TF session/bootstrap").  JAX is explicit-key; this module gives the rest
+of the framework one small, consistent idiom for deriving named streams so
+that runs are reproducible across host counts (fold in the process index only
+where per-host streams are wanted, e.g. data augmentation).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Sequence
+
+import jax
+
+
+def key_for(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def split_named(key: jax.Array, names: Sequence[str]) -> Dict[str, jax.Array]:
+    """Derive one independent stream per name (order-independent)."""
+    return {name: jax.random.fold_in(key, _stable_hash(name)) for name in names}
+
+
+def per_step(key: jax.Array, step) -> jax.Array:
+    """Stream for a given training step (works under jit with traced step)."""
+    return jax.random.fold_in(key, step)
+
+
+def per_host(key: jax.Array) -> jax.Array:
+    return jax.random.fold_in(key, jax.process_index())
+
+
+def _stable_hash(name: str) -> int:
+    # Python's hash() is salted per-process; use a tiny FNV-1a instead so the
+    # same name maps to the same stream on every host.
+    h = 2166136261
+    for b in name.encode():
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def stream(key: jax.Array) -> Iterator[jax.Array]:
+    """Infinite iterator of fresh keys (host-side loop use only)."""
+    while True:
+        key, sub = jax.random.split(key)
+        yield sub

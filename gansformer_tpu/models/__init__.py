@@ -1,0 +1,6 @@
+from gansformer_tpu.models.layers import EqualDense, EqualConv, minibatch_stddev
+from gansformer_tpu.models.attention import BipartiteAttention
+from gansformer_tpu.models.mapping import MappingNetwork
+from gansformer_tpu.models.synthesis import SynthesisNetwork
+from gansformer_tpu.models.discriminator import Discriminator
+from gansformer_tpu.models.generator import Generator

@@ -1,0 +1,111 @@
+"""Bipartite attention block — the GANsformer layer (SURVEY.md §2.3).
+
+Connects the k latent components Y ∈ R^{N×k×D} with the image feature grid
+X ∈ R^{N×n×C} (n = H·W).  Cost O(n·k): two batched einsums + a softmax over
+the tiny k axis — an MXU-friendly workload that shards over the batch axis
+with no attention-specific collectives.
+
+Simplex: grid attends to latents (Q from X, K/V from Y); the attended result
+updates the grid features region-wise ("attention-driven styling" instead of
+StyleGAN2's single global style).
+
+Duplex: the latents first update themselves from the grid — Y acts as
+key-value "centroids" tracking soft assignments (a k-means-like step) — and
+then the grid attends back to the refined latents.  ``kmeans_iters`` controls
+how many centroid refinement rounds run per block.
+
+Integration modes (reference's ``integration`` flag):
+  'add'  : X += proj(attended)
+  'mul'  : X  = norm(X) * (1 + a(attended))
+  'both' : X  = norm(X) * (1 + a(attended)) + b(attended)
+where norm is a non-affine instance norm over grid positions (the learned
+scale/shift comes from the attention output itself — that is the point).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gansformer_tpu.models.layers import EqualDense
+from gansformer_tpu.ops import multihead_attention, sinusoidal_grid_encoding
+
+
+def _instance_norm(x: jax.Array, axis: int = 1, eps: float = 1e-8) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(axis=axis, keepdims=True)
+    var = x32.var(axis=axis, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+class BipartiteAttention(nn.Module):
+    grid_dim: int            # C — channels of the grid features at this block
+    latent_dim: int          # D — width of the latent components
+    num_heads: int = 1
+    duplex: bool = False
+    integration: str = "both"
+    kmeans_iters: int = 1
+    pos_encoding: str = "sinusoidal"   # 'sinusoidal' | 'learned' | 'none'
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """x: [N,H,W,C] grid, y: [N,k,D] latents → (updated x, updated y)."""
+        n, h, w, c = x.shape
+        k = y.shape[1]
+        att = self.grid_dim  # attention width
+        assert att % self.num_heads == 0
+
+        grid = x.reshape(n, h * w, c)
+
+        # Positional encodings enter the grid's QUERIES/KEYS only (content
+        # stream stays position-free, as values carry content).
+        if self.pos_encoding == "sinusoidal":
+            pe_dim = max(4, (att // 4) * 4)
+            enc = jnp.asarray(sinusoidal_grid_encoding(h, w, pe_dim))
+            pos = EqualDense(att, dtype=self.dtype, name="pos_proj")(
+                enc.astype(self.dtype))[None]                      # [1,n,att]
+        elif self.pos_encoding == "learned":
+            pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                             (1, h * w, att), jnp.float32).astype(self.dtype)
+        else:
+            pos = jnp.zeros((1, 1, att), dtype=self.dtype)
+
+        grid_qk = grid.astype(self.dtype)
+
+        if self.duplex:
+            # Centroid phase: latents query the grid and absorb what their
+            # regions look like (soft k-means assignment + update).
+            for it in range(self.kmeans_iters):
+                q_y = EqualDense(att, dtype=self.dtype,
+                                 name=f"dup{it}_q_y")(y.astype(self.dtype))
+                k_x = EqualDense(att, dtype=self.dtype,
+                                 name=f"dup{it}_k_x")(grid_qk) + pos
+                v_x = EqualDense(self.latent_dim, dtype=self.dtype,
+                                 name=f"dup{it}_v_x")(grid.astype(self.dtype))
+                upd, _ = multihead_attention(q_y, k_x, v_x, self.num_heads)
+                gate = EqualDense(self.latent_dim, dtype=self.dtype,
+                                  name=f"dup{it}_gate")(upd)
+                y = y + jax.nn.sigmoid(gate.astype(jnp.float32)).astype(y.dtype) \
+                    * EqualDense(self.latent_dim, dtype=self.dtype,
+                                 name=f"dup{it}_proj")(upd).astype(y.dtype)
+
+        # Main phase: grid attends to (possibly refined) latents.
+        q_x = EqualDense(att, dtype=self.dtype, name="q_x")(grid_qk) + pos
+        k_y = EqualDense(att, dtype=self.dtype, name="k_y")(y.astype(self.dtype))
+        v_y = EqualDense(att, dtype=self.dtype, name="v_y")(y.astype(self.dtype))
+        out, _ = multihead_attention(q_x, k_y, v_y, self.num_heads)
+
+        if self.integration == "add":
+            grid = grid + EqualDense(c, dtype=self.dtype, name="o_proj")(out)
+        else:
+            scale = EqualDense(c, dtype=self.dtype, name="o_scale")(out)
+            normed = _instance_norm(grid, axis=1)
+            grid = normed * (1.0 + scale)
+            if self.integration == "both":
+                grid = grid + EqualDense(c, dtype=self.dtype, name="o_shift")(out)
+
+        return grid.reshape(n, h, w, c).astype(x.dtype), y

@@ -1,0 +1,75 @@
+"""Discriminator: StyleGAN2 residual D with optional bipartite attention.
+
+Reference: D_GANsformer in ``src/training/network.py`` (SURVEY.md §2.3):
+fromRGB at full resolution, residual blocks {conv 3×3, blur-pool down conv
+3×3, 1×1 skip-down, sum/√2}, minibatch-stddev at 4×4, dense head → logit.
+GANsformer optionally inserts bipartite attention with ``d_components``
+learned query vectors that aggregate region statistics from the grid.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gansformer_tpu.core.config import ModelConfig
+from gansformer_tpu.models.attention import BipartiteAttention
+from gansformer_tpu.models.layers import EqualConv, EqualDense, minibatch_stddev
+
+
+class Discriminator(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, img: jax.Array) -> jax.Array:
+        """img: [N, R, R, C] → logits [N, 1]."""
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        f = cfg.blur_filter
+        x = img.astype(dtype)
+        n = x.shape[0]
+
+        x = EqualConv(cfg.nf(cfg.resolution), kernel=1, act="lrelu",
+                      dtype=dtype, name="from_rgb")(x)
+
+        # D attention is independent of the generator's attention flag — it
+        # only keys off d_attention + the attn resolution window.
+        attn_res = (
+            {r for r in cfg.block_resolutions
+             if cfg.attn_start_res <= r <= cfg.attn_max_res}
+            if cfg.d_attention else set())
+        if cfg.d_attention:
+            queries = self.param("d_queries", nn.initializers.normal(1.0),
+                                 (1, cfg.d_components, cfg.w_dim), jnp.float32)
+            y = jnp.broadcast_to(
+                queries, (n, cfg.d_components, cfg.w_dim)).astype(dtype)
+
+        # resolution → resolution/2 residual blocks, down to 4×4
+        for res in reversed(cfg.block_resolutions[1:]):  # R, R/2, ..., 8
+            nf_out = cfg.nf(res // 2)
+            if res in attn_res:
+                x, y = BipartiteAttention(
+                    grid_dim=x.shape[-1], latent_dim=cfg.w_dim,
+                    num_heads=cfg.num_heads, duplex=True,
+                    integration=cfg.integration,
+                    pos_encoding=cfg.pos_encoding,
+                    dtype=dtype, name=f"b{res}_attn")(x, y)
+            t = EqualConv(x.shape[-1], act="lrelu", resample_filter=f,
+                          dtype=dtype, name=f"b{res}_conv0")(x)
+            t = EqualConv(nf_out, down=2, act="lrelu", resample_filter=f,
+                          dtype=dtype, name=f"b{res}_conv1")(t)
+            skip = EqualConv(nf_out, kernel=1, down=2, use_bias=False,
+                             resample_filter=f, dtype=dtype,
+                             name=f"b{res}_skip")(x)
+            x = (t + skip) * (1.0 / math.sqrt(2.0))
+
+        # 4×4 head
+        x = minibatch_stddev(x, cfg.mbstd_group_size, cfg.mbstd_num_features)
+        x = EqualConv(cfg.nf(4), act="lrelu", dtype=dtype, name="head_conv")(x)
+        x = x.reshape(n, -1)
+        x = EqualDense(cfg.nf(2), act="lrelu", dtype=dtype, name="head_fc")(x)
+        x = EqualDense(1, dtype=jnp.float32, name="head_out")(x.astype(jnp.float32))
+        return x

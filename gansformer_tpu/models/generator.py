@@ -1,0 +1,47 @@
+"""Generator = mapping + synthesis, with truncation support.
+
+Reference: ``G_GANsformer`` + the EMA clone ``Gs`` and truncation trick
+(SURVEY.md §2.3).  Unlike the reference — where truncation lives inside the
+pickled Network via a ``w_avg`` variable — the w statistics here are part of
+the train state (``w_avg`` EMA of mapping outputs), passed in explicitly at
+sampling time.  That keeps the module pure and jit-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gansformer_tpu.core.config import ModelConfig
+from gansformer_tpu.models.mapping import MappingNetwork
+from gansformer_tpu.models.synthesis import SynthesisNetwork
+
+
+class Generator(nn.Module):
+    cfg: ModelConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.mapping = MappingNetwork(
+            w_dim=cfg.w_dim, hidden_dim=cfg.mapping_dim,
+            num_layers=cfg.mapping_layers, lrmul=cfg.mapping_lrmul)
+        self.synthesis = SynthesisNetwork(cfg)
+
+    def __call__(self, z: jax.Array, noise_mode: str = "random",
+                 truncation_psi: float = 1.0,
+                 w_avg: Optional[jax.Array] = None) -> jax.Array:
+        """z: [N, num_ws, latent_dim] → images [N, R, R, C]."""
+        ws = self.mapping(z)
+        if truncation_psi != 1.0:
+            assert w_avg is not None, "truncation needs the w_avg EMA"
+            ws = w_avg[None, None, :] + truncation_psi * (ws - w_avg[None, None, :])
+        return self.synthesis(ws, noise_mode=noise_mode)
+
+    def map(self, z: jax.Array) -> jax.Array:
+        return self.mapping(z)
+
+    def synthesize(self, ws: jax.Array, noise_mode: str = "random") -> jax.Array:
+        return self.synthesis(ws, noise_mode=noise_mode)

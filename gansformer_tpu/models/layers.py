@@ -1,0 +1,143 @@
+"""Equalized-learning-rate layers + shared building blocks.
+
+StyleGAN2's trick (reference ``src/training/network.py``: ``get_weight`` with
+``he_std``/``lrmul`` runtime scaling, SURVEY.md §2.3): parameters are stored
+at unit scale and multiplied by ``gain/sqrt(fan_in) * lrmul`` at use time so
+Adam's per-parameter normalization sees identical gradient scales everywhere.
+Params live in fp32; compute may be bf16 (``dtype``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gansformer_tpu.ops import conv2d, fused_bias_act, modulated_conv2d
+
+
+def matmul_precision(dtype) -> lax.Precision:
+    """fp32 math runs at true fp32; bf16 rides the MXU natively."""
+    return lax.Precision.HIGHEST if dtype == jnp.float32 else lax.Precision.DEFAULT
+
+
+class EqualDense(nn.Module):
+    features: int
+    gain: float = 1.0
+    lrmul: float = 1.0
+    use_bias: bool = True
+    bias_init: float = 0.0
+    act: str = "linear"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        fan_in = x.shape[-1]
+        w = self.param("w", nn.initializers.normal(stddev=1.0 / self.lrmul),
+                       (fan_in, self.features), jnp.float32)
+        coef = self.gain / math.sqrt(fan_in) * self.lrmul
+        y = jnp.dot(x.astype(self.dtype), (w * coef).astype(self.dtype),
+                    precision=matmul_precision(self.dtype))
+        b = None
+        if self.use_bias:
+            b = self.param("b", nn.initializers.constant(self.bias_init),
+                           (self.features,), jnp.float32) * self.lrmul
+        return fused_bias_act(y, b, act=self.act)
+
+
+class EqualConv(nn.Module):
+    features: int
+    kernel: int = 3
+    up: int = 1
+    down: int = 1
+    gain: float = 1.0
+    lrmul: float = 1.0
+    use_bias: bool = True
+    act: str = "linear"
+    resample_filter: tuple = (1, 3, 3, 1)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        fan_in = x.shape[-1] * self.kernel**2
+        w = self.param("w", nn.initializers.normal(stddev=1.0 / self.lrmul),
+                       (self.kernel, self.kernel, x.shape[-1], self.features),
+                       jnp.float32)
+        coef = self.gain / math.sqrt(fan_in) * self.lrmul
+        y = conv2d(x.astype(self.dtype), (w * coef).astype(self.dtype),
+                   up=self.up, down=self.down,
+                   resample_filter=self.resample_filter)
+        b = None
+        if self.use_bias:
+            b = self.param("b", nn.initializers.zeros,
+                           (self.features,), jnp.float32) * self.lrmul
+        return fused_bias_act(y, b, act=self.act)
+
+
+class ModulatedConv(nn.Module):
+    """Style-modulated conv layer: affine(w_style) → modulated_conv2d → noise
+    → fused bias+act.  The per-layer unit of the synthesis network
+    (reference's ``layer()`` inside G_synthesis, SURVEY.md §2.3)."""
+
+    features: int
+    kernel: int = 3
+    up: int = 1
+    demodulate: bool = True
+    use_noise: bool = True
+    act: str = "lrelu"
+    resample_filter: tuple = (1, 3, 3, 1)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, w_style: jax.Array,
+                 noise_mode: str = "random") -> jax.Array:
+        cin = x.shape[-1]
+        # Style affine "A": bias-init 1 so styles start at identity.
+        styles = EqualDense(cin, bias_init=1.0, dtype=jnp.float32,
+                            name="affine")(w_style)
+        weight = self.param("w", nn.initializers.normal(stddev=1.0),
+                            (self.kernel, self.kernel, cin, self.features),
+                            jnp.float32)
+        coef = 1.0 / math.sqrt(cin * self.kernel**2)
+        y = modulated_conv2d(x.astype(self.dtype),
+                             (weight * coef).astype(self.dtype),
+                             styles, demodulate=self.demodulate, up=self.up,
+                             resample_filter=self.resample_filter)
+        assert noise_mode in ("random", "none"), f"bad noise_mode {noise_mode!r}"
+        if self.use_noise and noise_mode != "none":
+            strength = self.param("noise_strength", nn.initializers.zeros,
+                                  (), jnp.float32)
+            noise = jax.random.normal(self.make_rng("noise"),
+                                      y.shape[:3] + (1,), dtype=self.dtype)
+            y = y + noise * strength.astype(self.dtype)
+        b = self.param("b", nn.initializers.zeros, (self.features,), jnp.float32)
+        return fused_bias_act(y, b, act=self.act)
+
+
+def minibatch_stddev(x: jax.Array, group_size: int = 4,
+                     num_features: int = 1) -> jax.Array:
+    """Append cross-sample stddev statistics as extra channels.
+
+    Reference: minibatch-stddev layer in D (SURVEY.md §2.3).  Under a sharded
+    batch axis the mean over N is handled by GSPMD (becomes a psum over the
+    data mesh axis), exactly replacing the reference's in-graph per-tower
+    behavior — but global, which is strictly better.
+    """
+    n, h, w, c = x.shape
+    g = min(group_size, n)
+    while n % g != 0:
+        g -= 1
+    f = num_features
+    # groups of g CONSECUTIVE samples
+    y = x.reshape(n // g, g, h, w, f, c // f).astype(jnp.float32)
+    y = y - y.mean(axis=1, keepdims=True)
+    y = jnp.sqrt(jnp.square(y).mean(axis=1) + 1e-8)   # [n/g, h, w, f, c/f]
+    y = y.mean(axis=(1, 2, 4))                        # [n/g, f]
+    y = jnp.repeat(y, g, axis=0).reshape(n, 1, 1, f)
+    y = jnp.broadcast_to(y, (n, h, w, f)).astype(x.dtype)
+    return jnp.concatenate([x, y], axis=-1)

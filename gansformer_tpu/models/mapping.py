@@ -1,0 +1,38 @@
+"""Mapping network: z → w, shared across the k latent components.
+
+Reference: the 8-layer FC mapping of G_GANsformer (``src/training/network.py``
+G_mapping; SURVEY.md §2.3) — lrelu MLP with 0.01 lr-multiplier, input
+pixel-norm per component.  The same MLP maps every component (weight sharing),
+so the Dense-on-last-axis broadcast over the component axis is the whole
+implementation — no per-component loop.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gansformer_tpu.models.layers import EqualDense
+
+
+class MappingNetwork(nn.Module):
+    w_dim: int = 512
+    hidden_dim: int = 512
+    num_layers: int = 8
+    lrmul: float = 0.01
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        """z: [N, num_ws, latent_dim] → w: [N, num_ws, w_dim] (fp32)."""
+        assert z.ndim == 3
+        x = z.astype(jnp.float32)
+        # per-component pixel norm
+        x = x * jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+                              + 1e-8)
+        for i in range(self.num_layers - 1):
+            x = EqualDense(self.hidden_dim, lrmul=self.lrmul, act="lrelu",
+                           name=f"fc{i}")(x)
+        x = EqualDense(self.w_dim, lrmul=self.lrmul, act="lrelu",
+                       name=f"fc{self.num_layers - 1}")(x)
+        return x

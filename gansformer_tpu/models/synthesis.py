@@ -1,0 +1,83 @@
+"""Synthesis network: const 4×4 → modulated-conv blocks with bipartite
+attention → RGB skip accumulation.
+
+Reference: G_synthesis of ``src/training/network.py`` (SURVEY.md §2.3):
+StyleGAN2 skeleton — learned constant input, per-resolution {up-conv, conv}
+pairs with noise + fused lrelu, tRGB skip summation with FIR-upsampled
+accumulation — augmented with simplex/duplex bipartite attention between the
+k latent components and the grid at resolutions 4..attn_max_res.
+
+Style routing: the dedicated *global* latent component drives every conv's
+modulation (StyleGAN2-style global statistics); the k components inject
+region-wise structure through the attention blocks.  This is the same split
+of responsibilities the reference implements.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gansformer_tpu.core.config import ModelConfig
+from gansformer_tpu.models.attention import BipartiteAttention
+from gansformer_tpu.models.layers import ModulatedConv
+from gansformer_tpu.ops import upsample_2d
+
+
+class SynthesisNetwork(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, ws: jax.Array, noise_mode: str = "random") -> jax.Array:
+        """ws: [N, num_ws, w_dim] → images [N, R, R, C] in [-1, 1]-ish range."""
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        n = ws.shape[0]
+        assert ws.shape[1] == cfg.num_ws
+
+        # Global component drives conv styles; the k components feed attention.
+        if cfg.use_global:
+            w_global = ws[:, -1]
+            y = ws[:, : cfg.components]
+        else:
+            w_global = ws.mean(axis=1)
+            y = ws
+        y = y.astype(dtype)
+
+        attn_res = set(cfg.attn_resolutions())
+        f = cfg.blur_filter
+
+        const = self.param("const", nn.initializers.normal(1.0),
+                           (1, 4, 4, cfg.nf(4)), jnp.float32)
+        x = jnp.broadcast_to(const, (n, 4, 4, cfg.nf(4))).astype(dtype)
+
+        rgb: Optional[jax.Array] = None
+        for res in cfg.block_resolutions:
+            nf = cfg.nf(res)
+            if res > 4:
+                x = ModulatedConv(nf, up=2, resample_filter=f, dtype=dtype,
+                                  name=f"b{res}_conv_up")(x, w_global,
+                                                          noise_mode=noise_mode)
+            x = ModulatedConv(nf, resample_filter=f, dtype=dtype,
+                              name=f"b{res}_conv")(x, w_global,
+                                                   noise_mode=noise_mode)
+            if res in attn_res:
+                x, y = BipartiteAttention(
+                    grid_dim=nf, latent_dim=cfg.w_dim,
+                    num_heads=cfg.num_heads,
+                    duplex=(cfg.attention == "duplex"),
+                    integration=cfg.integration,
+                    kmeans_iters=cfg.kmeans_iters,
+                    pos_encoding=cfg.pos_encoding,
+                    dtype=dtype, name=f"b{res}_attn")(x, y)
+            # tRGB skip: modulated 1×1, no demod, linear act.
+            t = ModulatedConv(cfg.img_channels, kernel=1, demodulate=False,
+                              use_noise=False, act="linear", dtype=dtype,
+                              name=f"b{res}_trgb")(x, w_global,
+                                                   noise_mode="none")
+            rgb = t if rgb is None else upsample_2d(rgb, f) + t
+
+        return rgb.astype(jnp.float32)

@@ -1,0 +1,10 @@
+from gansformer_tpu.ops.upfirdn2d import (
+    upfirdn2d,
+    setup_filter,
+    upsample_2d,
+    downsample_2d,
+    filter_2d,
+)
+from gansformer_tpu.ops.fused_bias_act import fused_bias_act, ACTIVATIONS
+from gansformer_tpu.ops.modulated_conv import modulated_conv2d, conv2d
+from gansformer_tpu.ops.attention import multihead_attention, sinusoidal_grid_encoding

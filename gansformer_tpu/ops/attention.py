@@ -1,0 +1,77 @@
+"""Bipartite-attention primitives.
+
+The GANsformer's defining op (SURVEY.md §2.3): attention between the k latent
+components Y (k ≤ 32) and the image feature grid X (n = H·W positions).  Cost
+is O(n·k) — linear in pixels — which is the scalability property to preserve:
+on TPU this is two batched einsums plus a softmax over a tiny axis, an ideal
+MXU workload, and it shards trivially over the batch axis of the data mesh
+(SURVEY.md §5 "Long-context": no ring/Ulysses machinery is required; if
+attention were ever enabled at 1024² the n axis can be sharded with a ~50-line
+shard_map — documented decision, not built).
+
+Softmax statistics are computed in fp32 even under bfloat16 compute.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def multihead_attention(
+    q: jax.Array,           # [N, Lq, D]
+    k: jax.Array,           # [N, Lk, D]
+    v: jax.Array,           # [N, Lk, Dv]
+    num_heads: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched multi-head dot-product attention over pre-projected q/k/v.
+
+    Returns (out [N, Lq, Dv], probs [N, heads, Lq, Lk]).  The probs are
+    exposed for diagnostics/visualization of the latent→region assignment
+    maps (and are asserted row-stochastic in tests).
+    """
+    n, lq, d = q.shape
+    _, lk, dv = v.shape
+    assert d % num_heads == 0 and dv % num_heads == 0
+    dh = d // num_heads
+    qh = q.reshape(n, lq, num_heads, dh).astype(jnp.float32)
+    kh = k.reshape(n, lk, num_heads, dh).astype(jnp.float32)
+    vh = v.reshape(n, lk, num_heads, dv // num_heads)
+    # fp32 stats at full precision; bf16 inputs would ride the MXU directly.
+    prec = (jax.lax.Precision.HIGHEST if v.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    logits = jnp.einsum("nqhd,nkhd->nhqk", qh, kh,
+                        precision=jax.lax.Precision.HIGHEST) / math.sqrt(dh)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("nhqk,nkhd->nqhd", probs.astype(vh.dtype), vh,
+                     precision=prec)
+    return out.reshape(n, lq, dv), probs
+
+
+def sinusoidal_grid_encoding(height: int, width: int, dim: int) -> np.ndarray:
+    """2D sinusoidal positional encoding for the n = H·W grid positions.
+
+    Returns a static [H*W, dim] fp32 array (numpy: baked into the jaxpr as a
+    constant — no recompute per step).  Matches the capability of the
+    reference's sinusoidal grid encodings for the attention layers
+    (SURVEY.md §2.3); learned encodings live in the model layer.
+    """
+    assert dim % 4 == 0, "positional dim must be divisible by 4"
+    quarter = dim // 4
+    freqs = 1.0 / (10000.0 ** (np.arange(quarter, dtype=np.float64) / quarter))
+    ys = np.arange(height, dtype=np.float64)[:, None] * freqs[None, :]  # [H,q]
+    xs = np.arange(width, dtype=np.float64)[:, None] * freqs[None, :]   # [W,q]
+    enc_y = np.concatenate([np.sin(ys), np.cos(ys)], axis=-1)  # [H, dim/2]
+    enc_x = np.concatenate([np.sin(xs), np.cos(xs)], axis=-1)  # [W, dim/2]
+    grid = np.concatenate(
+        [
+            np.broadcast_to(enc_y[:, None, :], (height, width, dim // 2)),
+            np.broadcast_to(enc_x[None, :, :], (height, width, dim // 2)),
+        ],
+        axis=-1,
+    )
+    return grid.reshape(height * width, dim).astype(np.float32)

@@ -1,0 +1,59 @@
+"""fused_bias_act — ``act(x + b) * gain`` (+ optional clamp).
+
+TPU-native re-design of the reference's custom CUDA kernel
+``src/dnnlib/tflib/ops/fused_bias_act.cu`` + wrapper (SURVEY.md §2.1).  The
+reference hand-fuses bias-add and activation into one kernel and hand-writes
+first- AND second-order gradients (the second order is needed because R1
+differentiates through the discriminator's activations).
+
+On TPU none of that machinery is needed: this is a pure ``jnp`` composite that
+XLA fuses into the preceding matmul/conv (it is exactly the elementwise
+epilogue fusion the hardware wants), and autodiff provides arbitrarily-high
+derivative orders.  Keeping it a plain composite — rather than a custom_vjp —
+is a deliberate choice (SURVEY.md §7.3 item 1): every custom rule would have
+to be differentiable itself for R1/path-length to work.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_SQRT2 = math.sqrt(2.0)
+
+# name -> (fn(x, alpha), default_gain).  Matches the reference's activation
+# table (linear/relu/lrelu/tanh/sigmoid/elu/selu/softplus/swish).
+ACTIVATIONS = {
+    "linear": (lambda x, a: x, 1.0),
+    "relu": (lambda x, a: jnp.maximum(x, 0.0), _SQRT2),
+    "lrelu": (lambda x, a: jnp.where(x >= 0, x, x * a), _SQRT2),
+    "tanh": (lambda x, a: jnp.tanh(x), 1.0),
+    "sigmoid": (lambda x, a: jax.nn.sigmoid(x), 1.0),
+    "elu": (lambda x, a: jax.nn.elu(x), 1.0),
+    "selu": (lambda x, a: jax.nn.selu(x), 1.0),
+    "softplus": (lambda x, a: jax.nn.softplus(x), 1.0),
+    "swish": (lambda x, a: jax.nn.silu(x), _SQRT2),
+}
+
+
+def fused_bias_act(x: jax.Array, b: Optional[jax.Array] = None,
+                   act: str = "linear", alpha: float = 0.2,
+                   gain: Optional[float] = None,
+                   clamp: Optional[float] = None) -> jax.Array:
+    """Apply ``act(x + b) * gain`` with the bias broadcast over the channel
+    (last) axis; optionally clamp to ``[-clamp, clamp]``."""
+    fn, def_gain = ACTIVATIONS[act]
+    if b is not None:
+        assert b.ndim == 1 and b.shape[0] == x.shape[-1]
+        x = x + b.astype(x.dtype)
+    x = fn(x, alpha)
+    g = def_gain if gain is None else gain
+    if g != 1.0:
+        x = x * jnp.asarray(g, dtype=x.dtype)
+    if clamp is not None:
+        assert clamp >= 0
+        x = jnp.clip(x, -clamp, clamp)
+    return x

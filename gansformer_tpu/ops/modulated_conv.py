@@ -1,0 +1,107 @@
+"""Style-modulated convolution + resampling convs.
+
+TPU-native re-design of StyleGAN2's ``modulated_conv2d_layer`` and the
+``upsample_conv_2d`` / ``conv_downsample_2d`` helpers inside the reference's
+``src/training/network.py`` / ``src/dnnlib/tflib/ops/upfirdn_2d.py``
+(SURVEY.md §2.1).
+
+The reference folds the per-sample modulated weights into a single grouped
+convolution ("fused" path: batch folded into channels) — a trick that exists
+to keep cuDNN happy.  On TPU the better mapping is the *input-scaling*
+identity the reference's non-fused path also uses:
+
+    conv(x, w * s)  ==  conv(x * s, w)        (s broadcast over in-channels)
+
+so every sample shares ONE large conv — exactly what the MXU wants (one big
+batched contraction, no per-sample weight gather) — followed by a per-sample,
+per-output-channel demodulation scale computed with a tiny einsum.  All steps
+are XLA-fusable and arbitrarily differentiable (R1/path-length need 2nd-order
+grads through this op; SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gansformer_tpu.ops.upfirdn2d import filter_2d, upsample_2d, setup_filter, upfirdn2d
+
+
+def _conv(x: jax.Array, w: jax.Array, stride: int = 1,
+          padding: str = "SAME") -> jax.Array:
+    # fp32 inputs get true-fp32 accumulation (XLA's DEFAULT precision may
+    # drop fp32 convs to bf16 passes); bf16 inputs ride the MXU natively.
+    precision = (lax.Precision.HIGHEST if x.dtype == jnp.float32
+                 else lax.Precision.DEFAULT)
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=precision,
+    )
+
+
+def conv2d(x: jax.Array, w: jax.Array, up: int = 1, down: int = 1,
+           resample_filter: Sequence[float] = (1, 3, 3, 1)) -> jax.Array:
+    """Plain conv with optional FIR-filtered up/down-sampling.
+
+    Capability match for the reference's ``conv2d_layer`` with
+    ``up=True``/``down=True`` (blur is fused into the resampling, reference
+    ``upsample_conv_2d``/``conv_downsample_2d``).  NHWC, HWIO.
+    """
+    assert x.ndim == 4 and w.ndim == 4
+    kh, kw = w.shape[0], w.shape[1]
+    if up > 1:
+        # zero-insert upsample + anti-imaging blur, then the conv at the
+        # higher resolution.  Equivalent to the reference's transposed-conv
+        # formulation (convolutions commute); XLA sees the same dilated conv.
+        x = upsample_2d(x, resample_filter, factor=up)
+    if down > 1:
+        # Fold the VALID conv's padding into the blur, then stride the conv.
+        f = setup_filter(resample_filter)
+        p = (f.shape[0] - down) + (kh - 1)
+        x = upfirdn2d(x, f, pad=((p + 1) // 2, p // 2))
+        return _conv(x, w, stride=down, padding="VALID")
+    return _conv(x, w, stride=1, padding="SAME")
+
+
+def modulated_conv2d(
+    x: jax.Array,                 # [N, H, W, Cin]
+    w: jax.Array,                 # [kh, kw, Cin, Cout]
+    styles: jax.Array,            # [N, Cin]
+    demodulate: bool = True,
+    up: int = 1,
+    down: int = 1,
+    resample_filter: Sequence[float] = (1, 3, 3, 1),
+    eps: float = 1e-8,
+) -> jax.Array:
+    """Modulate → conv → demodulate (StyleGAN2's core layer, SURVEY.md §2.1).
+
+    ``styles`` are per-sample input-channel scales (already passed through the
+    affine ``A`` layer by the caller).  Demodulation normalizes each output
+    channel by the L2 norm of its modulated weights, computed per sample
+    without materializing per-sample weights.
+    """
+    assert x.ndim == 4 and w.ndim == 4 and styles.ndim == 2
+    n, _, _, cin = x.shape
+    assert w.shape[2] == cin and styles.shape == (n, cin)
+
+    # Demod coefficients in fp32 regardless of compute dtype (rsqrt of a sum
+    # of squares is precision-sensitive; the reference keeps modulation math
+    # in fp32 too).
+    w32 = w.astype(jnp.float32)
+    s32 = styles.astype(jnp.float32)
+
+    x = x * styles.astype(x.dtype)[:, None, None, :]
+    y = conv2d(x, w, up=up, down=down, resample_filter=resample_filter)
+
+    if demodulate:
+        sigma = jnp.einsum("hwio,ni->no", jnp.square(w32), jnp.square(s32),
+                           precision=lax.Precision.HIGHEST)
+        d = lax.rsqrt(sigma + eps)                      # [N, Cout]
+        y = y * d.astype(y.dtype)[:, None, None, :]
+    return y

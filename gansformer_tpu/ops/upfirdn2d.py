@@ -1,0 +1,127 @@
+"""upfirdn2d — pad → upsample → FIR filter → downsample, in one XLA conv.
+
+TPU-native re-design of the reference's custom CUDA kernel
+``src/dnnlib/tflib/ops/upfirdn_2d.cu`` + its Python wrapper
+``src/dnnlib/tflib/ops/upfirdn_2d.py`` (SURVEY.md §2.1).  The reference
+compiles a hand-written CUDA kernel at import time (via nvcc in
+``custom_ops.py``) and registers a custom TF gradient (another upfirdn call
+with a flipped filter).
+
+Here the whole operation is ONE ``lax.conv_general_dilated`` call:
+
+  * zero-insertion upsampling  -> ``lhs_dilation=(up, up)``
+  * zero padding / cropping    -> the conv ``padding`` pairs (negative = crop)
+  * FIR convolution            -> a depthwise kernel (``feature_group_count=C``)
+                                  with the filter flipped, because XLA convs
+                                  are correlations and upfirdn is a true
+                                  convolution
+  * downsampling               -> ``window_strides=(down, down)``
+
+XLA lowers this straight onto the TPU convolution path, and — unlike the
+reference — the gradient (and the second-order gradient R1 needs) falls out
+of autodiff for free; no ``custom_vjp`` is required.
+
+Layout note: the whole framework is NHWC (TPU-preferred), vs the reference's
+NCHW.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Pad = Union[int, Tuple[int, int], Tuple[int, int, int, int]]
+
+
+def setup_filter(f: Sequence[float], normalize: bool = True,
+                 gain: float = 1.0) -> np.ndarray:
+    """Build the 2D FIR filter from a 1D (separable) or 2D tap list.
+
+    Mirrors the reference's ``_setup_kernel`` semantics: a 1D filter becomes
+    its outer product; the filter is normalized to unit sum, then scaled by
+    ``gain``.
+    """
+    f = np.asarray(f, dtype=np.float32)
+    if f.ndim == 1:
+        f = np.outer(f, f)
+    assert f.ndim == 2
+    if normalize:
+        f = f / f.sum()
+    return f * gain
+
+
+def _pad4(pad: Pad) -> Tuple[int, int, int, int]:
+    if isinstance(pad, int):
+        return (pad, pad, pad, pad)
+    if len(pad) == 2:
+        return (pad[0], pad[1], pad[0], pad[1])
+    assert len(pad) == 4
+    return tuple(pad)  # (pady0, pady1, padx0, padx1)
+
+
+def upfirdn2d(x: jax.Array, f, up: int = 1, down: int = 1,
+              pad: Pad = 0) -> jax.Array:
+    """Upsample, pad, FIR-filter and downsample a batch of NHWC images.
+
+    Semantics (matching the reference wrapper's docstring):
+      1. zero-insertion upsample by ``up`` in both spatial dims,
+      2. zero-pad by ``pad`` = (pady0, pady1, padx0, padx1) (negative crops),
+      3. convolve with the 2D FIR filter ``f`` (true convolution),
+      4. keep every ``down``-th sample.
+    """
+    assert x.ndim == 4, "expected NHWC"
+    f = jnp.asarray(f, dtype=x.dtype)
+    assert f.ndim == 2
+    pady0, pady1, padx0, padx1 = _pad4(pad)
+    n, h, w, c = x.shape
+    # Depthwise kernel, flipped so the XLA correlation computes a convolution.
+    kernel = jnp.tile(f[::-1, ::-1, None, None], (1, 1, 1, c))  # HWIO, I=1
+    # upfirdn's zero-insertion upsample yields H*up samples (zeros AFTER the
+    # last sample too); lhs_dilation yields (H-1)*up+1.  Fold the missing
+    # up-1 trailing zeros into the trailing padding so sizes/values match the
+    # reference semantics exactly.
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(down, down),
+        padding=((pady0, pady1 + up - 1), (padx0, padx1 + up - 1)),
+        lhs_dilation=(up, up),
+        rhs_dilation=(1, 1),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+        # The FIR filter is 4 taps and depthwise — bandwidth-bound, not
+        # MXU-bound — so full precision costs nothing and keeps the blur
+        # numerics exact even under TPU bf16 defaults (wrong blur padding or
+        # precision silently degrades FID; SURVEY.md §7.3 item 5).
+        precision=lax.Precision.HIGHEST,
+    )
+
+
+def upsample_2d(x: jax.Array, f, factor: int = 2, gain: float = 1.0) -> jax.Array:
+    """Upsample with FIR anti-imaging filter (reference: ``upsample_2d``)."""
+    f = setup_filter(f, gain=gain * (factor**2))
+    p = f.shape[0] - factor
+    return upfirdn2d(x, f, up=factor,
+                     pad=((p + 1) // 2 + factor - 1, p // 2))
+
+
+def downsample_2d(x: jax.Array, f, factor: int = 2, gain: float = 1.0) -> jax.Array:
+    """Blur-pool downsample (reference: ``downsample_2d``)."""
+    f = setup_filter(f, gain=gain)
+    p = f.shape[0] - factor
+    return upfirdn2d(x, f, down=factor, pad=((p + 1) // 2, p // 2))
+
+
+def filter_2d(x: jax.Array, f, gain: float = 1.0,
+              extra_pad: Tuple[int, int] = (0, 0)) -> jax.Array:
+    """Same-resolution blur (reference: ``filter_2d``); ``extra_pad`` lets
+    callers fold a following VALID conv's padding into the blur, the trick the
+    reference's ``conv_downsample_2d`` / ``upsample_conv_2d`` use."""
+    f = setup_filter(f, gain=gain)
+    p = f.shape[0] - 1
+    return upfirdn2d(x, f,
+                     pad=((p + 1) // 2 + extra_pad[0], p // 2 + extra_pad[1]))

@@ -1,0 +1,8 @@
+from gansformer_tpu.parallel.mesh import (
+    MeshEnv,
+    make_mesh,
+    batch_sharding,
+    replicated,
+    init_distributed,
+    local_batch_size,
+)

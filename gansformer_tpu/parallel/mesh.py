@@ -1,0 +1,111 @@
+"""Device mesh & sharding layer — the framework's entire "comm backend".
+
+The reference's distributed story is single-host in-graph GPU towers with an
+NCCL gradient all-reduce buried in ``src/dnnlib/tflib/optimizer.py``
+(SURVEY.md §2.4, T1 BASELINE.json:5).  On TPU that whole subsystem collapses
+into this module: build a ``jax.sharding.Mesh``, hand out ``NamedSharding``\\ s,
+and let XLA insert ``psum``/``all_gather`` collectives over ICI (intra-slice)
+and DCN (cross-slice).  ``jit`` over sharded inputs *is* data parallelism;
+there is no replica loop and no hand-written all-reduce anywhere in the
+framework.
+
+Axes:
+  ``data``  — batch axis (the only axis GANsformer needs; O(n·k) attention and
+              ≤~30M-param models make TP/PP unnecessary — SURVEY.md §2.4).
+  ``model`` — reserved hook, size 1 by default, so that tensor-parallel
+              shardings can be introduced without touching call sites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gansformer_tpu.core.config import MeshConfig
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshEnv:
+    """A constructed mesh plus the shardings the training engine needs."""
+
+    mesh: Mesh
+
+    @property
+    def data_size(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    @property
+    def model_size(self) -> int:
+        return self.mesh.shape[MODEL_AXIS]
+
+    def batch(self) -> NamedSharding:
+        """Shard leading (batch) axis over the data axis; replicate the rest."""
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_batch(self, tree):
+        """Device-put a host-local batch tree onto the data axis."""
+        sh = self.batch()
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def init_distributed(cfg: MeshConfig) -> None:
+    """Form the multi-host process group (no-op for single-process runs).
+
+    Replaces the reference's "one process drives all GPUs" model: each host
+    runs one process, ``jax.distributed.initialize`` forms the group, and the
+    global mesh spans every chip in the slice.
+    """
+    if cfg.coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+
+
+def make_mesh(cfg: MeshConfig = MeshConfig(),
+              devices: Optional[Sequence[jax.Device]] = None) -> MeshEnv:
+    devices = list(devices if devices is not None else jax.devices())
+    data, model = cfg.axis_sizes(len(devices))
+    if data * model > len(devices):
+        raise ValueError(
+            f"mesh {data}x{model} needs {data * model} devices, have {len(devices)}")
+    grid = np.asarray(devices[: data * model]).reshape(data, model)
+    return MeshEnv(mesh=Mesh(grid, (DATA_AXIS, MODEL_AXIS)))
+
+
+def batch_sharding(env: MeshEnv) -> NamedSharding:
+    return env.batch()
+
+
+def replicated(env: MeshEnv) -> NamedSharding:
+    return env.replicated()
+
+
+def local_batch_size(global_batch: int, env: MeshEnv) -> int:
+    """Per-process share of the global batch (multi-host input pipeline).
+
+    Each data-axis row holds one batch shard (replicated across the model
+    axis), so the local share is per-row batch × the number of data rows
+    whose devices live on this process.
+    """
+    if global_batch % env.data_size != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by data axis {env.data_size}")
+    per_row = global_batch // env.data_size
+    pid = jax.process_index()
+    local_mesh_devices = sum(
+        1 for d in env.mesh.devices.flat if d.process_index == pid)
+    local_rows = max(1, local_mesh_devices // env.model_size)
+    return per_row * local_rows

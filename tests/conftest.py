@@ -1,0 +1,24 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+This is the JAX answer to "test multi-device without a cluster"
+(SURVEY.md §4): every test sees 8 CPU devices, so sharding/collective paths
+are exercised for real, just slowly.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

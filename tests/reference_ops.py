@@ -1,0 +1,101 @@
+"""Pure-numpy oracle implementations of the native ops.
+
+These play the role of the reference's inline `'ref'` implementations
+(`impl='ref'` switch in `src/dnnlib/tflib/ops/*.py`, SURVEY.md §4 item 2):
+slow, obviously-correct math that the fast XLA paths must match bit-for-bit
+(to fp32 tolerance).
+"""
+
+import numpy as np
+
+
+def upfirdn2d_ref(x, f, up=1, down=1, pad=(0, 0, 0, 0)):
+    """x: [N,H,W,C] fp64/fp32, f: [fh,fw]. pad = (pady0,pady1,padx0,padx1)."""
+    n, h, w, c = x.shape
+    fh, fw = f.shape
+    pady0, pady1, padx0, padx1 = pad
+    # 1. zero-insert upsample (zeros after every sample, incl. the last)
+    z = np.zeros((n, h * up, w * up, c), dtype=x.dtype)
+    z[:, ::up, ::up, :] = x
+    # 2. pad (negative = crop)
+    z = np.pad(z, ((0, 0),
+                   (max(pady0, 0), max(pady1, 0)),
+                   (max(padx0, 0), max(padx1, 0)),
+                   (0, 0)))
+    z = z[:,
+          max(-pady0, 0): z.shape[1] - max(-pady1, 0),
+          max(-padx0, 0): z.shape[2] - max(-padx1, 0), :]
+    # 3. true convolution with f (flip + correlate)
+    ff = f[::-1, ::-1]
+    oh, ow = z.shape[1] - fh + 1, z.shape[2] - fw + 1
+    out = np.zeros((n, oh, ow, c), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, i, j, :] = np.einsum(
+                "nhwc,hw->nc", z[:, i:i + fh, j:j + fw, :], ff)
+    # 4. keep every down-th sample
+    return out[:, ::down, ::down, :]
+
+
+def setup_filter_ref(f, gain=1.0):
+    f = np.asarray(f, dtype=np.float64)
+    if f.ndim == 1:
+        f = np.outer(f, f)
+    return f / f.sum() * gain
+
+
+def fused_bias_act_ref(x, b=None, act="linear", alpha=0.2, gain=None, clamp=None):
+    x = np.asarray(x, dtype=np.float64)
+    if b is not None:
+        x = x + b.reshape((1,) * (x.ndim - 1) + (-1,))
+    acts = {
+        "linear": (lambda v: v, 1.0),
+        "relu": (lambda v: np.maximum(v, 0), np.sqrt(2)),
+        "lrelu": (lambda v: np.where(v >= 0, v, v * alpha), np.sqrt(2)),
+        "tanh": (np.tanh, 1.0),
+        "sigmoid": (lambda v: 1 / (1 + np.exp(-v)), 1.0),
+    }
+    fn, def_gain = acts[act]
+    y = fn(x) * (def_gain if gain is None else gain)
+    if clamp is not None:
+        y = np.clip(y, -clamp, clamp)
+    return y
+
+
+def modulated_conv2d_ref(x, w, styles, demodulate=True, eps=1e-8):
+    """Direct per-sample weight modulation (the definition, not the trick).
+
+    x: [N,H,W,Ci], w: [kh,kw,Ci,Co], styles: [N,Ci].  SAME padding, stride 1.
+    """
+    n, h, w_sz, ci = x.shape
+    kh, kw, _, co = w.shape
+    out = np.zeros((n, h, w_sz, co), dtype=np.float64)
+    ph, pw = kh // 2, kw // 2
+    xp = np.pad(x, ((0, 0), (ph, kh - 1 - ph), (pw, kw - 1 - pw), (0, 0)))
+    for s in range(n):
+        ws = w * styles[s][None, None, :, None]          # modulate
+        if demodulate:
+            d = 1.0 / np.sqrt(np.sum(ws**2, axis=(0, 1, 2)) + eps)
+            ws = ws * d[None, None, None, :]             # demodulate
+        for i in range(h):
+            for j in range(w_sz):
+                patch = xp[s, i:i + kh, j:j + kw, :]
+                out[s, i, j, :] = np.einsum("hwi,hwio->o", patch, ws)
+    return out
+
+
+def attention_ref(q, k, v, num_heads=1):
+    n, lq, d = q.shape
+    _, lk, dv = v.shape
+    dh = d // num_heads
+    out = np.zeros((n, lq, dv), dtype=np.float64)
+    for s in range(n):
+        for hd in range(num_heads):
+            qs = q[s, :, hd * dh:(hd + 1) * dh]
+            ks = k[s, :, hd * dh:(hd + 1) * dh]
+            vs = v[s][:, hd * (dv // num_heads):(hd + 1) * (dv // num_heads)]
+            logits = qs @ ks.T / np.sqrt(dh)
+            e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+            p = e / e.sum(axis=-1, keepdims=True)
+            out[s, :, hd * (dv // num_heads):(hd + 1) * (dv // num_heads)] = p @ vs
+    return out

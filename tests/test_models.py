@@ -1,0 +1,112 @@
+"""Golden shape/dtype/finiteness tests for the model zoo (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gansformer_tpu.core.config import ModelConfig, get_preset
+from gansformer_tpu.models import (
+    BipartiteAttention,
+    Discriminator,
+    Generator,
+    MappingNetwork,
+    SynthesisNetwork,
+)
+
+TINY = ModelConfig(resolution=32, components=4, latent_dim=32, w_dim=32,
+                   mapping_dim=32, mapping_layers=2, fmap_base=512,
+                   fmap_max=64, attention="duplex", attn_start_res=8,
+                   attn_max_res=16)
+
+
+def _z(cfg, n=2, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(n, cfg.num_ws, cfg.latent_dim).astype(np.float32))
+
+
+def test_mapping_shapes():
+    m = MappingNetwork(w_dim=32, hidden_dim=32, num_layers=3)
+    z = _z(TINY)
+    params = m.init(jax.random.PRNGKey(0), z)
+    w = m.apply(params, z)
+    assert w.shape == (2, TINY.num_ws, 32)
+    assert np.isfinite(np.asarray(w)).all()
+
+
+@pytest.mark.parametrize("mode", ["none", "simplex", "duplex"])
+def test_synthesis_shapes(mode):
+    cfg = ModelConfig(**{**TINY.__dict__, "attention": mode})
+    net = SynthesisNetwork(cfg)
+    ws = jnp.zeros((2, cfg.num_ws, cfg.w_dim))
+    params = net.init({"params": jax.random.PRNGKey(0),
+                       "noise": jax.random.PRNGKey(1)}, ws)
+    img = net.apply(params, ws, rngs={"noise": jax.random.PRNGKey(2)})
+    assert img.shape == (2, 32, 32, 3)
+    assert img.dtype == jnp.float32
+    assert np.isfinite(np.asarray(img)).all()
+
+
+def test_generator_end_to_end_and_truncation():
+    g = Generator(TINY)
+    z = _z(TINY)
+    params = g.init({"params": jax.random.PRNGKey(0),
+                     "noise": jax.random.PRNGKey(1)}, z)
+    img = g.apply(params, z, rngs={"noise": jax.random.PRNGKey(2)})
+    assert img.shape == (2, 32, 32, 3)
+    # truncation toward w_avg must change the output
+    w_avg = jnp.zeros((TINY.w_dim,))
+    img_t = g.apply(params, z, truncation_psi=0.5, w_avg=w_avg,
+                    rngs={"noise": jax.random.PRNGKey(2)})
+    assert not np.allclose(np.asarray(img), np.asarray(img_t))
+
+
+@pytest.mark.parametrize("d_attention", [False, True])
+def test_discriminator_shapes(d_attention):
+    cfg = ModelConfig(**{**TINY.__dict__, "d_attention": d_attention,
+                         "d_components": 4})
+    d = Discriminator(cfg)
+    img = jnp.asarray(np.random.RandomState(0)
+                      .randn(4, 32, 32, 3).astype(np.float32))
+    params = d.init(jax.random.PRNGKey(0), img)
+    logits = d.apply(params, img)
+    assert logits.shape == (4, 1)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_bipartite_attention_updates_latents_in_duplex():
+    attn = BipartiteAttention(grid_dim=16, latent_dim=16, duplex=True)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 8, 16).astype(np.float32))
+    y = jnp.asarray(np.random.RandomState(1).randn(2, 4, 16).astype(np.float32))
+    params = attn.init(jax.random.PRNGKey(0), x, y)
+    x2, y2 = attn.apply(params, x, y)
+    assert x2.shape == x.shape and y2.shape == y.shape
+    assert not np.allclose(np.asarray(y), np.asarray(y2))  # duplex updates Y
+
+    simplex = BipartiteAttention(grid_dim=16, latent_dim=16, duplex=False)
+    sp = simplex.init(jax.random.PRNGKey(0), x, y)
+    _, y3 = simplex.apply(sp, x, y)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y3))  # simplex doesn't
+
+
+def test_bf16_compute_path():
+    cfg = ModelConfig(**{**TINY.__dict__, "dtype": "bfloat16"})
+    g = Generator(cfg)
+    z = _z(cfg)
+    params = g.init({"params": jax.random.PRNGKey(0),
+                     "noise": jax.random.PRNGKey(1)}, z)
+    # params stay fp32
+    leaves = jax.tree_util.tree_leaves(params)
+    assert all(l.dtype == jnp.float32 for l in leaves)
+    img = g.apply(params, z, rngs={"noise": jax.random.PRNGKey(2)})
+    assert img.dtype == jnp.float32
+    assert np.isfinite(np.asarray(img)).all()
+
+
+def test_preset_configs_instantiable():
+    for name in ["clevr64-simplex", "ffhq256-duplex"]:
+        cfg = get_preset(name).model
+        assert cfg.block_resolutions[-1] == cfg.resolution
+        assert cfg.nf(4) <= cfg.fmap_max
+        assert len(cfg.attn_resolutions()) >= 1
