@@ -1,0 +1,91 @@
+"""Benchmark — training throughput on the flagship FFHQ-256 Duplex config.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: ≥200 img/sec/chip on TPU v4 (BASELINE.json:5).
+
+Measures the steady-state hot loop (D step + G step, with the lazy-reg
+variants mixed in at their real cadence) on synthetic data, excluding
+compilation, on however many chips are visible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 200.0
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from gansformer_tpu.core.config import get_preset
+    import dataclasses
+
+    from gansformer_tpu.parallel.mesh import make_mesh
+    from gansformer_tpu.train.state import create_train_state
+    from gansformer_tpu.train.steps import make_train_steps
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    cfg = get_preset("ffhq256-duplex")
+    # per-chip batch 8 (v4 HBM-friendly); global batch scales with chips
+    batch = (8 * n_chips) if on_tpu else max(4, n_chips)
+    if not on_tpu:
+        # CPU fallback so the bench always emits a line: tiny proxy config.
+        cfg = get_preset("clevr64-simplex")
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, dtype="float32"))
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, batch_size=batch))
+
+    env = make_mesh(cfg.mesh)
+    state = create_train_state(cfg, jax.random.PRNGKey(0))
+    state = jax.device_put(state, env.replicated())
+    fns = make_train_steps(cfg, env, batch_size=batch)
+
+    res = cfg.model.resolution
+    imgs = np.random.RandomState(0).randint(
+        0, 255, (batch, res, res, 3), dtype=np.uint8)
+    imgs = jax.device_put(imgs, env.batch())
+    rng = jax.random.PRNGKey(1)
+
+    t = cfg.train
+
+    def step(state, it):
+        srng = jax.random.fold_in(rng, it)
+        d_fn = fns.d_step_r1 if it % t.d_reg_interval == 0 else fns.d_step
+        state, _ = d_fn(state, imgs, jax.random.fold_in(srng, 0))
+        g_fn = fns.g_step_pl if it % t.g_reg_interval == 0 else fns.g_step
+        state, _ = g_fn(state, jax.random.fold_in(srng, 1))
+        return state
+
+    # warmup: compile all four variants
+    for it in range(max(t.d_reg_interval, t.g_reg_interval) + 1):
+        state = step(state, it)
+    jax.block_until_ready(state.step)
+
+    iters = 30 if on_tpu else 5
+    t0 = time.time()
+    for it in range(iters):
+        state = step(state, it)
+    jax.block_until_ready(state.step)
+    dt = time.time() - t0
+
+    img_per_sec = iters * batch / dt
+    img_per_sec_per_chip = img_per_sec / n_chips
+    print(json.dumps({
+        "metric": "train_img_per_sec_per_chip_ffhq256_duplex"
+                  if on_tpu else "train_img_per_sec_per_chip_cpu_proxy",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Evaluate CLI — metric-only runs against a snapshot (the role of the
+reference's ``run_metrics``/generate.py metric path; SURVEY.md §3.3)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import jax
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Run FID/IS on a checkpoint")
+    p.add_argument("--run-dir", required=True)
+    p.add_argument("--metrics", default="fid50k,is50k")
+    p.add_argument("--num-images", type=int, default=None,
+                   help="override metric sample count (e.g. 1000 for smoke)")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--truncation-psi", type=float, default=1.0)
+    p.add_argument("--inception-npz", default=None)
+    p.add_argument("--cache-dir", default=None)
+    args = p.parse_args(argv)
+
+    from gansformer_tpu.core.config import ExperimentConfig
+    from gansformer_tpu.data.dataset import make_dataset
+    from gansformer_tpu.metrics.inception import make_extractor
+    from gansformer_tpu.metrics.metric_base import MetricGroup, parse_metric_names
+    from gansformer_tpu.train import checkpoint as ckpt
+    from gansformer_tpu.train.state import create_train_state
+    from gansformer_tpu.train.steps import make_train_steps
+
+    with open(os.path.join(args.run_dir, "config.json")) as f:
+        cfg = ExperimentConfig.from_json(f.read())
+    template = create_train_state(cfg, jax.random.PRNGKey(0))
+    state = ckpt.restore(os.path.join(args.run_dir, "checkpoints"), template)
+    fns = make_train_steps(cfg, batch_size=args.batch_size)
+    dataset = make_dataset(cfg.data)
+
+    # --num-images overrides the sample count *at construction* so the
+    # metric name (and the metric-<name>.txt it lands in) stays honest.
+    metrics = parse_metric_names(args.metrics, batch_size=args.batch_size,
+                                 num_images=args.num_images)
+    group = MetricGroup(metrics, make_extractor(args.inception_npz),
+                        cache_dir=args.cache_dir or
+                        os.path.join(args.run_dir, "metric-cache"))
+
+    rng_holder = [jax.random.PRNGKey(7)]
+
+    def sample_fn(n):
+        rng_holder[0], k1, k2 = jax.random.split(rng_holder[0], 3)
+        z = jax.random.normal(k1, (n, cfg.model.num_ws, cfg.model.latent_dim))
+        return fns.sample(state.ema_params, state.w_avg, z, k2,
+                          truncation_psi=args.truncation_psi)
+
+    results = group.run(sample_fn, dataset)
+    kimg = int(jax.device_get(state.step)) / 1000
+    for name, val in results.items():
+        print(f"{name}: {val:.4f}")
+        path = os.path.join(args.run_dir, f"metric-{name}.txt")
+        with open(path, "a") as f:
+            f.write(f"kimg {kimg:<10.1f} {name} {val:.6f}\n")
+    print(json.dumps({"kimg": kimg, **results}))
+
+
+if __name__ == "__main__":
+    main()

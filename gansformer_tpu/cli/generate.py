@@ -1,0 +1,63 @@
+"""Generate CLI — reference ``src/generate.py`` (SURVEY.md §3.5): load a
+snapshot, sample images with truncation ψ, write PNG grids."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import numpy as np
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Sample images from a checkpoint")
+    p.add_argument("--run-dir", required=True,
+                   help="run dir containing checkpoints/ + config.json")
+    p.add_argument("--out", default=None, help="output dir (default run dir)")
+    p.add_argument("--images-num", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--truncation-psi", type=float, default=0.7)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--grid", action="store_true", help="one grid PNG instead of singles")
+    args = p.parse_args(argv)
+
+    from gansformer_tpu.core.config import ExperimentConfig
+    from gansformer_tpu.train import checkpoint as ckpt
+    from gansformer_tpu.train.state import create_train_state
+    from gansformer_tpu.train.steps import make_train_steps
+    from gansformer_tpu.utils.image import save_image_grid, to_uint8
+
+    with open(os.path.join(args.run_dir, "config.json")) as f:
+        cfg = ExperimentConfig.from_json(f.read())
+    template = create_train_state(cfg, jax.random.PRNGKey(0))
+    state = ckpt.restore(os.path.join(args.run_dir, "checkpoints"), template)
+    fns = make_train_steps(cfg, batch_size=args.batch_size)
+
+    out_dir = args.out or os.path.join(args.run_dir, "generated")
+    os.makedirs(out_dir, exist_ok=True)
+    rng = jax.random.PRNGKey(args.seed)
+    all_imgs = []
+    for i in range(0, args.images_num, args.batch_size):
+        n = min(args.batch_size, args.images_num - i)
+        z = jax.random.normal(jax.random.fold_in(rng, i),
+                              (n, cfg.model.num_ws, cfg.model.latent_dim))
+        imgs = fns.sample(state.ema_params, state.w_avg, z,
+                          jax.random.fold_in(rng, i + 1),
+                          truncation_psi=args.truncation_psi)
+        all_imgs.append(np.asarray(jax.device_get(imgs)))
+    imgs = np.concatenate(all_imgs)
+
+    if args.grid:
+        save_image_grid(imgs, os.path.join(out_dir, "grid.png"))
+        print(os.path.join(out_dir, "grid.png"))
+    else:
+        from PIL import Image
+
+        for i, im in enumerate(to_uint8(imgs)):
+            Image.fromarray(im).save(os.path.join(out_dir, f"img{i:04d}.png"))
+        print(f"{len(imgs)} images → {out_dir}")
+
+
+if __name__ == "__main__":
+    main()

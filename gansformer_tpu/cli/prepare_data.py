@@ -1,0 +1,52 @@
+"""Dataset preparation CLI — the role of the reference's ``dataset_tool.py``
++ ``prepare_data.py`` (SURVEY.md §3.4): convert an image folder (or a
+builtin synthetic source) into a packed training archive.
+
+Output format is this framework's fast path (``.npz`` with uint8 NHWC
+``images``), not TFRecords — the TFRecord *reader* exists for datasets
+already prepared for the reference (data/dataset.py), so conversion is only
+needed for new datasets.  Downloads are out of scope in an airgapped image;
+point --source-dir at data you already have.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Prepare a training dataset")
+    p.add_argument("--source-dir", default=None,
+                   help="directory of images (recursively scanned)")
+    p.add_argument("--synthetic", action="store_true",
+                   help="generate the procedural smoke dataset instead")
+    p.add_argument("--out", required=True, help="output .npz path")
+    p.add_argument("--resolution", type=int, default=256)
+    p.add_argument("--max-images", type=int, default=None)
+    args = p.parse_args(argv)
+
+    if args.synthetic:
+        from gansformer_tpu.data.dataset import SyntheticDataset
+
+        n = args.max_images or 10000
+        ds = SyntheticDataset(resolution=args.resolution, num_images=n)
+        imgs = ds._make(np.arange(n))
+    elif args.source_dir:
+        from gansformer_tpu.data.dataset import ImageFolderDataset
+
+        ds = ImageFolderDataset(args.source_dir, resolution=args.resolution)
+        files = ds.files[: args.max_images] if args.max_images else ds.files
+        imgs = np.stack([ds._load(f) for f in files])
+    else:
+        p.error("need --source-dir or --synthetic")
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    np.savez_compressed(args.out, images=imgs)
+    print(f"{len(imgs)} images @ {args.resolution}² → {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Train CLI — the reference's ``src/train.py`` entrypoint surface
+(SURVEY.md §2.2 "Train CLI", §3.1) re-expressed over typed configs:
+pick a preset (the five driver configs, BASELINE.json:7-11), override fields
+from flags, create a numbered run dir, train.
+
+Examples
+--------
+  python -m gansformer_tpu.cli.train --preset clevr64-simplex --total-kimg 10
+  python -m gansformer_tpu.cli.train --preset ffhq256-duplex \\
+      --data-path /data/ffhq-tfrecords --batch-size 64 --resume
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+
+from gansformer_tpu.core.config import (
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, TrainConfig,
+    get_preset, PRESETS)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="GANsformer-TPU training")
+    p.add_argument("--preset", default="clevr64-simplex", choices=sorted(PRESETS))
+    p.add_argument("--config", default=None,
+                   help="JSON config file (e.g. a run dir's config.json); "
+                        "overrides --preset, flags still apply on top")
+    p.add_argument("--results-dir", default="results")
+    p.add_argument("--desc", default=None, help="run dir description suffix")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from latest checkpoint in --resume-dir")
+    p.add_argument("--resume-dir", default=None,
+                   help="run dir to resume (default: a fresh run dir)")
+    # model overrides (reference flags: --g-arch, --components-num, ...)
+    p.add_argument("--attention", choices=["none", "simplex", "duplex"])
+    p.add_argument("--components", type=int, help="k latent components")
+    p.add_argument("--resolution", type=int)
+    p.add_argument("--dtype", choices=["float32", "bfloat16"])
+    # training overrides
+    p.add_argument("--batch-size", type=int)
+    p.add_argument("--total-kimg", type=int)
+    p.add_argument("--g-lr", type=float)
+    p.add_argument("--d-lr", type=float)
+    p.add_argument("--r1-gamma", type=float)
+    p.add_argument("--seed", type=int)
+    # data overrides
+    p.add_argument("--data-path", default=None)
+    p.add_argument("--data-source",
+                   choices=["synthetic", "npz", "tfrecord", "folder"])
+    p.add_argument("--mirror-augment", action="store_true")
+    # mesh / multi-host (replaces reference --num-gpus)
+    p.add_argument("--mesh-data", type=int, default=-1,
+                   help="data-axis size; -1 = all devices")
+    p.add_argument("--coordinator", default=None,
+                   help="host:port for jax.distributed.initialize")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    return p
+
+
+def config_from_args(args) -> ExperimentConfig:
+    if getattr(args, "config", None):
+        with open(args.config) as f:
+            cfg = ExperimentConfig.from_json(f.read())
+    else:
+        cfg = get_preset(args.preset)
+
+    def override(obj, **kv):
+        kv = {k: v for k, v in kv.items() if v is not None}
+        return dataclasses.replace(obj, **kv) if kv else obj
+
+    model = override(cfg.model, attention=args.attention,
+                     components=args.components, resolution=args.resolution,
+                     dtype=args.dtype)
+    train = override(cfg.train, batch_size=args.batch_size,
+                     total_kimg=args.total_kimg, g_lr=args.g_lr,
+                     d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed)
+    data = override(cfg.data, path=args.data_path, source=args.data_source,
+                    resolution=args.resolution)
+    if args.mirror_augment:
+        data = dataclasses.replace(data, mirror_augment=True)
+    mesh = MeshConfig(data=args.mesh_data,
+                      coordinator_address=args.coordinator,
+                      num_processes=args.num_processes,
+                      process_id=args.process_id)
+    return ExperimentConfig(name=cfg.name, model=model, train=train,
+                            data=data, mesh=mesh)
+
+
+def _latest_run_dir(results_dir: str):
+    """Most recent numbered run dir (the reference's results/ convention)."""
+    from gansformer_tpu.utils.logging import list_run_dirs
+
+    runs = list_run_dirs(results_dir)
+    return runs[-1] if runs else None
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+
+    from gansformer_tpu.parallel.mesh import init_distributed
+    from gansformer_tpu.train.loop import train
+    from gansformer_tpu.utils.logging import (
+        RunLogger, create_run_dir, list_run_dirs, next_run_id)
+
+    run_dir = None
+    if args.resume:
+        run_dir = args.resume_dir or _latest_run_dir(args.results_dir)
+        if run_dir is None or not os.path.isdir(
+                os.path.join(run_dir, "checkpoints")):
+            raise SystemExit(
+                f"--resume: no run dir with checkpoints found "
+                f"(looked in {args.resume_dir or args.results_dir}); "
+                f"pass --resume-dir explicitly")
+        # Resume continues the RUN'S config (flags still override on top);
+        # falling back to the preset would silently train a different model
+        # into the old run dir.
+        if not args.config:
+            saved = os.path.join(run_dir, "config.json")
+            if os.path.exists(saved):
+                args.config = saved
+    cfg = config_from_args(args)
+    init_distributed(cfg.mesh)
+
+    import jax
+
+    is_main = jax.process_index() == 0
+    if run_dir is None:
+        desc = args.desc or f"{cfg.name}-{cfg.model.attention}-k{cfg.model.components}"
+        if jax.process_count() > 1:
+            # All hosts must agree on the run dir; process 0 picks the id
+            # and broadcasts it (a shared results dir would otherwise race).
+            from jax.experimental import multihost_utils
+            import numpy as np
+
+            rid = multihost_utils.broadcast_one_to_all(
+                np.int32(next_run_id(args.results_dir) if is_main else 0))
+            run_dir = create_run_dir(args.results_dir, desc,
+                                     run_id=int(rid), create=is_main)
+        else:
+            run_dir = create_run_dir(args.results_dir, desc)
+    if not args.resume and is_main:
+        with open(os.path.join(run_dir, "config.json"), "w") as f:
+            f.write(cfg.to_json())
+    logger = RunLogger(run_dir, active=is_main)
+    logger.write(f"run dir: {run_dir}")
+    train(cfg, run_dir, resume=args.resume, logger=logger)
+
+
+if __name__ == "__main__":
+    main()

@@ -136,7 +136,11 @@ class TrainConfig:
     kimg_per_tick: int = 4
     snapshot_ticks: int = 10
     image_snapshot_ticks: int = 10
+    # in-loop metric runs every metric_ticks (reference: per-snapshot FID).
+    # ``metrics`` is a comma list ('fid10k,is10k'); empty = disabled (run
+    # cli/evaluate.py per checkpoint instead).
     metric_ticks: int = 50
+    metrics: str = ""
 
     seed: int = 0
 

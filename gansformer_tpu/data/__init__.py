@@ -1,0 +1,8 @@
+from gansformer_tpu.data.dataset import (
+    Dataset,
+    SyntheticDataset,
+    NpzDataset,
+    TFRecordDataset,
+    ImageFolderDataset,
+    make_dataset,
+)

@@ -1,0 +1,6 @@
+from gansformer_tpu.losses.gan import (
+    g_nonsaturating_loss,
+    d_logistic_loss,
+    r1_penalty,
+    path_length_penalty,
+)

@@ -1,0 +1,7 @@
+from gansformer_tpu.metrics.fid import (
+    frechet_distance,
+    compute_activation_stats,
+    fid_from_features,
+)
+from gansformer_tpu.metrics.inception_score import inception_score
+from gansformer_tpu.metrics.metric_base import MetricGroup, FIDMetric, ISMetric

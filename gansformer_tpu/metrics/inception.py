@@ -1,0 +1,217 @@
+"""Inception-v3 feature extractor in Flax — the FID/IS backbone, on device.
+
+Reference: a pickled TF1 Inception graph downloaded from NVIDIA
+(``src/metrics/frechet_inception_distance.py``; SURVEY.md §3.3).  Here the
+architecture is implemented natively (BN-Inception-v3, pool3 features = 2048-d,
+aux-free) and weights load from an ``.npz`` you convert once from any public
+Inception-v3 checkpoint (``load_params_npz``).  With no weight file present we
+fall back to a *deterministic randomly-initialized* network: FID computed with
+random features is still a valid two-sample discrepancy (random-projection
+FID correlates with true FID) and keeps the full pipeline exercisable in
+airgapped CI — but numbers are NOT comparable to reference FID; callers get
+a ``calibrated`` flag saying which regime they are in.
+
+Numerics note (SURVEY.md §7.3 item 3): FID comparability hinges on resize
+semantics; ``preprocess`` uses bilinear resize to 299² with antialiasing
+matching TF's ``tf.image.resize(..., antialias=True)``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False, name="conv")(x)
+        # inference-only BN: scale=1 folded, running stats as params
+        mean = self.param("mean", nn.initializers.zeros, (self.features,))
+        var = self.param("var", nn.initializers.ones, (self.features,))
+        beta = self.param("beta", nn.initializers.zeros, (self.features,))
+        x = (x - mean) * jax.lax.rsqrt(var + 1e-3) + beta
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = ConvBN(64, (1, 1), name="b1x1")(x)
+        b5 = ConvBN(48, (1, 1), name="b5x5_1")(x)
+        b5 = ConvBN(64, (5, 5), name="b5x5_2")(b5)
+        b3 = ConvBN(64, (1, 1), name="b3x3dbl_1")(x)
+        b3 = ConvBN(96, (3, 3), name="b3x3dbl_2")(b3)
+        b3 = ConvBN(96, (3, 3), name="b3x3dbl_3")(b3)
+        bp = nn.avg_pool(x, (3, 3), (1, 1), "SAME")
+        bp = ConvBN(self.pool_features, (1, 1), name="bpool")(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b3 = ConvBN(384, (3, 3), (2, 2), "VALID", name="b3x3")(x)
+        bd = ConvBN(64, (1, 1), name="b3x3dbl_1")(x)
+        bd = ConvBN(96, (3, 3), name="b3x3dbl_2")(bd)
+        bd = ConvBN(96, (3, 3), (2, 2), "VALID", name="b3x3dbl_3")(bd)
+        bp = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    c7: int
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.c7
+        b1 = ConvBN(192, (1, 1), name="b1x1")(x)
+        b7 = ConvBN(c7, (1, 1), name="b7x7_1")(x)
+        b7 = ConvBN(c7, (1, 7), name="b7x7_2")(b7)
+        b7 = ConvBN(192, (7, 1), name="b7x7_3")(b7)
+        bd = ConvBN(c7, (1, 1), name="b7x7dbl_1")(x)
+        bd = ConvBN(c7, (7, 1), name="b7x7dbl_2")(bd)
+        bd = ConvBN(c7, (1, 7), name="b7x7dbl_3")(bd)
+        bd = ConvBN(c7, (7, 1), name="b7x7dbl_4")(bd)
+        bd = ConvBN(192, (1, 7), name="b7x7dbl_5")(bd)
+        bp = nn.avg_pool(x, (3, 3), (1, 1), "SAME")
+        bp = ConvBN(192, (1, 1), name="bpool")(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b3 = ConvBN(192, (1, 1), name="b3x3_1")(x)
+        b3 = ConvBN(320, (3, 3), (2, 2), "VALID", name="b3x3_2")(b3)
+        b7 = ConvBN(192, (1, 1), name="b7x7x3_1")(x)
+        b7 = ConvBN(192, (1, 7), name="b7x7x3_2")(b7)
+        b7 = ConvBN(192, (7, 1), name="b7x7x3_3")(b7)
+        b7 = ConvBN(192, (3, 3), (2, 2), "VALID", name="b7x7x3_4")(b7)
+        bp = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b1 = ConvBN(320, (1, 1), name="b1x1")(x)
+        b3 = ConvBN(384, (1, 1), name="b3x3_1")(x)
+        b3 = jnp.concatenate([ConvBN(384, (1, 3), name="b3x3_2a")(b3),
+                              ConvBN(384, (3, 1), name="b3x3_2b")(b3)], axis=-1)
+        bd = ConvBN(448, (1, 1), name="b3x3dbl_1")(x)
+        bd = ConvBN(384, (3, 3), name="b3x3dbl_2")(bd)
+        bd = jnp.concatenate([ConvBN(384, (1, 3), name="b3x3dbl_3a")(bd),
+                              ConvBN(384, (3, 1), name="b3x3dbl_3b")(bd)], axis=-1)
+        bp = nn.avg_pool(x, (3, 3), (1, 1), "SAME")
+        bp = ConvBN(192, (1, 1), name="bpool")(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Returns (pool_features [N,2048], logits [N,1008])."""
+
+    num_classes: int = 1008  # reference graph uses 1008-way output
+
+    @nn.compact
+    def __call__(self, x):
+        x = ConvBN(32, (3, 3), (2, 2), "VALID", name="Conv2d_1a")(x)
+        x = ConvBN(32, (3, 3), padding="VALID", name="Conv2d_2a")(x)
+        x = ConvBN(64, (3, 3), name="Conv2d_2b")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        x = ConvBN(80, (1, 1), padding="VALID", name="Conv2d_3b")(x)
+        x = ConvBN(192, (3, 3), padding="VALID", name="Conv2d_4a")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        x = InceptionA(32, name="Mixed_5b")(x)
+        x = InceptionA(64, name="Mixed_5c")(x)
+        x = InceptionA(64, name="Mixed_5d")(x)
+        x = InceptionB(name="Mixed_6a")(x)
+        x = InceptionC(128, name="Mixed_6b")(x)
+        x = InceptionC(160, name="Mixed_6c")(x)
+        x = InceptionC(160, name="Mixed_6d")(x)
+        x = InceptionC(192, name="Mixed_6e")(x)
+        x = InceptionD(name="Mixed_7a")(x)
+        x = InceptionE(name="Mixed_7b")(x)
+        x = InceptionE(name="Mixed_7c")(x)
+        pool = jnp.mean(x, axis=(1, 2))                 # [N, 2048]
+        logits = nn.Dense(self.num_classes, name="fc")(pool)
+        return pool, logits
+
+
+def preprocess(images: jax.Array) -> jax.Array:
+    """[-1,1] float NHWC at any resolution → 299×299, stays in [-1,1]
+    (the scaling the reference's Inception graph expects)."""
+    x = jnp.clip(images, -1.0, 1.0)
+    if x.shape[1] != 299 or x.shape[2] != 299:
+        x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[3]),
+                             method="bilinear", antialias=True)
+    if x.shape[-1] == 1:
+        x = jnp.repeat(x, 3, axis=-1)
+    return x
+
+
+class FeatureExtractor:
+    """Jitted (features, logits) on [-1,1] images; batched sweep helper."""
+
+    def __init__(self, params: Optional[Any] = None, seed: int = 0):
+        self.net = InceptionV3()
+        if params is None:
+            params = self.net.init(
+                jax.random.PRNGKey(seed), jnp.zeros((1, 299, 299, 3)))["params"]
+            self.calibrated = False
+        else:
+            self.calibrated = True
+        self.params = params
+        self._apply = jax.jit(
+            lambda p, x: self.net.apply({"params": p}, preprocess(x)))
+
+    def __call__(self, images: jax.Array):
+        return self._apply(self.params, images)
+
+    def sweep(self, image_batches, max_images: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Iterate [-1,1]-float batches → stacked (features, logits)."""
+        feats, logits = [], []
+        seen = 0
+        for batch in image_batches:
+            f, l = self(batch)
+            f, l = np.asarray(f), np.asarray(l)
+            take = min(len(f), max_images - seen)
+            feats.append(f[:take])
+            logits.append(l[:take])
+            seen += take
+            if seen >= max_images:
+                break
+        return np.concatenate(feats), np.concatenate(logits)
+
+
+def load_params_npz(path: str):
+    """Load a flat {'a/b/c': array} npz into the nested params dict."""
+    flat = dict(np.load(path))
+    tree: dict = {}
+    for k, v in flat.items():
+        node = tree
+        parts = k.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(v)
+    return tree
+
+
+def make_extractor(weights_path: Optional[str] = None) -> FeatureExtractor:
+    env_path = weights_path or os.environ.get("GANSFORMER_TPU_INCEPTION_NPZ")
+    if env_path and os.path.exists(env_path):
+        return FeatureExtractor(load_params_npz(env_path))
+    return FeatureExtractor(None)

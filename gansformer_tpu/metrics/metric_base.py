@@ -1,0 +1,154 @@
+"""Metric registry + real-statistics caching — reference ``metric_base.py``
+(SURVEY.md §2.2, §3.3): metrics run per snapshot; Inception activations of
+the real dataset are computed once and cached on disk keyed by dataset.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Callable, Dict, Iterator, List, Optional
+
+import jax
+import numpy as np
+
+from gansformer_tpu.data.dataset import Dataset, normalize_images
+from gansformer_tpu.metrics.fid import compute_activation_stats, frechet_distance
+from gansformer_tpu.metrics.inception import FeatureExtractor, make_extractor
+from gansformer_tpu.metrics.inception_score import inception_score
+
+
+class Metric:
+    name: str = "metric"
+
+    def run(self, sample_fn: Callable[[int], jax.Array], dataset: Dataset,
+            extractor: FeatureExtractor, cache_dir: Optional[str]) -> Dict[str, float]:
+        raise NotImplementedError
+
+
+def _real_stats(dataset: Dataset, extractor: FeatureExtractor,
+                num_images: int, batch_size: int,
+                cache_dir: Optional[str]):
+    """(μ, Σ) of real-image features, disk-cached like the reference's
+    per-dataset activation pickles."""
+    key = None
+    if cache_dir:
+        tag = f"{dataset.cache_tag()}-{num_images}-" \
+              f"{'cal' if extractor.calibrated else 'rand'}"
+        key = os.path.join(
+            cache_dir, "real-stats-" +
+            hashlib.md5(tag.encode()).hexdigest()[:16] + ".npz")
+        if os.path.exists(key):
+            z = np.load(key)
+            return z["mu"], z["sigma"]
+    feats = []
+    seen = 0
+    for batch in dataset.batches(batch_size, seed=123):
+        imgs = normalize_images(np.asarray(batch["image"], np.float32))
+        f, _ = extractor(imgs)
+        take = min(len(f), num_images - seen)
+        feats.append(np.asarray(f[:take]))
+        seen += take
+        if seen >= num_images:
+            break
+    mu, sigma = compute_activation_stats(np.concatenate(feats))
+    if key:
+        os.makedirs(cache_dir, exist_ok=True)
+        np.savez(key, mu=mu, sigma=sigma)
+    return mu, sigma
+
+
+def _fake_features(sample_fn, extractor, num_images: int, batch_size: int):
+    feats, logits = [], []
+    seen = 0
+    while seen < num_images:
+        imgs = sample_fn(batch_size)
+        f, l = extractor(imgs)
+        take = min(batch_size, num_images - seen)
+        feats.append(np.asarray(f[:take]))
+        logits.append(np.asarray(l[:take]))
+        seen += take
+    return np.concatenate(feats), np.concatenate(logits)
+
+
+def _count_tag(n: int) -> str:
+    return f"{n // 1000}k" if n >= 1000 and n % 1000 == 0 else str(n)
+
+
+class FIDMetric(Metric):
+    """FID@N — the north-star metric (BASELINE.json:2)."""
+
+    def __init__(self, num_images: int = 50000, batch_size: int = 32):
+        self.name = f"fid{_count_tag(num_images)}"
+        self.num_images = num_images
+        self.batch_size = batch_size
+
+    def run(self, sample_fn, dataset, extractor, cache_dir):
+        mu_r, s_r = _real_stats(dataset, extractor,
+                                min(self.num_images,
+                                    dataset.num_images or self.num_images),
+                                self.batch_size, cache_dir)
+        feats, _ = _fake_features(sample_fn, extractor, self.num_images,
+                                  self.batch_size)
+        mu_f, s_f = compute_activation_stats(feats)
+        return {self.name: frechet_distance(mu_r, s_r, mu_f, s_f)}
+
+
+class ISMetric(Metric):
+    def __init__(self, num_images: int = 50000, batch_size: int = 32,
+                 splits: int = 10):
+        self.name = f"is{_count_tag(num_images)}"
+        self.num_images = num_images
+        self.batch_size = batch_size
+        self.splits = splits
+
+    def run(self, sample_fn, dataset, extractor, cache_dir):
+        _, logits = _fake_features(sample_fn, extractor, self.num_images,
+                                   self.batch_size)
+        mean, std = inception_score(logits, self.splits)
+        return {f"{self.name}_mean": mean, f"{self.name}_std": std}
+
+
+class MetricGroup:
+    """Run a set of metrics against one generator snapshot — the analog of
+    the reference's ``MetricGroup.run(snapshot_pkl, dataset)``."""
+
+    def __init__(self, metrics: List[Metric],
+                 extractor: Optional[FeatureExtractor] = None,
+                 cache_dir: Optional[str] = None):
+        self.metrics = metrics
+        self.extractor = extractor or make_extractor()
+        self.cache_dir = cache_dir
+
+    def run(self, sample_fn: Callable[[int], jax.Array],
+            dataset: Dataset) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for m in self.metrics:
+            out.update(m.run(sample_fn, dataset, self.extractor, self.cache_dir))
+        out["calibrated"] = float(self.extractor.calibrated)
+        return out
+
+
+def parse_metric_names(names: str, num_images: Optional[int] = None,
+                       batch_size: int = 32) -> List[Metric]:
+    """'fid50k,is50k' → metric objects (reference CLI --metrics flag).
+
+    An explicit ``num_images`` overrides the count encoded in the name —
+    and the metric object renames itself accordingly, so a 1k-sample smoke
+    FID is never logged as fid50k.
+    """
+    def parse_count(suffix: str) -> int:
+        if not suffix:
+            return 50000
+        return (int(suffix[:-1]) * 1000 if suffix.endswith("k")
+                else int(suffix))
+
+    out: List[Metric] = []
+    for n in filter(None, names.split(",")):
+        if n.startswith("fid"):
+            out.append(FIDMetric(num_images or parse_count(n[3:]), batch_size))
+        elif n.startswith("is"):
+            out.append(ISMetric(num_images or parse_count(n[2:]), batch_size))
+        else:
+            raise ValueError(f"unknown metric {n!r}")
+    return out

@@ -107,5 +107,10 @@ def local_batch_size(global_batch: int, env: MeshEnv) -> int:
     pid = jax.process_index()
     local_mesh_devices = sum(
         1 for d in env.mesh.devices.flat if d.process_index == pid)
-    local_rows = max(1, local_mesh_devices // env.model_size)
+    local_rows = local_mesh_devices // env.model_size
+    if local_rows == 0:
+        raise ValueError(
+            f"process {pid} contributes no devices to the mesh "
+            f"{dict(zip(env.mesh.axis_names, env.mesh.devices.shape))}; "
+            f"shrink the process set or grow the mesh")
     return per_row * local_rows

@@ -1,0 +1,3 @@
+from gansformer_tpu.train.state import TrainState, create_train_state
+from gansformer_tpu.train.steps import TrainStepFns, make_train_steps
+from gansformer_tpu.train.loop import train

@@ -1,0 +1,72 @@
+"""Checkpoint / resume — Orbax-backed full-state snapshots.
+
+The reference checkpoints by pickling ``Network`` objects (code + weights)
+as ``network-snapshot-<kimg>.pkl`` and does NOT save optimizer state —
+Adam moments silently reset on resume (SURVEY.md §5 "Checkpoint / resume").
+Here the whole ``TrainState`` pytree (params, both Adam states, EMA params,
+w_avg, pl_mean, step) round-trips atomically, plus the resolved config JSON
+so a checkpoint is self-describing.  ``--resume`` auto-picks the latest step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from gansformer_tpu.core.config import ExperimentConfig
+from gansformer_tpu.train.state import TrainState
+
+
+_MANAGERS: dict = {}
+
+
+def _manager(ckpt_dir: str, max_to_keep: int = 5):
+    """One CheckpointManager per directory — construction spins up worker
+    threads and directory scans, so save/latest_step/restore share it."""
+    import orbax.checkpoint as ocp
+
+    key = os.path.abspath(ckpt_dir)
+    if key not in _MANAGERS:
+        _MANAGERS[key] = ocp.CheckpointManager(
+            key,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True),
+        )
+    return _MANAGERS[key]
+
+
+def save(ckpt_dir: str, state: TrainState, cfg: Optional[ExperimentConfig] = None,
+         max_to_keep: int = 5) -> None:
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(ckpt_dir, max_to_keep)
+    step = int(jax.device_get(state.step))
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    if cfg is not None:
+        cfg_path = os.path.join(ckpt_dir, "config.json")
+        if not os.path.exists(cfg_path):
+            with open(cfg_path, "w") as f:
+                f.write(cfg.to_json())
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    mgr = _manager(ckpt_dir)
+    return mgr.latest_step()
+
+
+def restore(ckpt_dir: str, template: TrainState,
+            step: Optional[int] = None) -> TrainState:
+    """Restore into the structure of ``template`` (shapes/dtypes/shardings
+    come from the template — works under any mesh)."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(ckpt_dir)
+    step = step if step is not None else mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    return mgr.restore(step, args=ocp.args.StandardRestore(template))

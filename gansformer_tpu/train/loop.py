@@ -1,0 +1,188 @@
+"""Training loop — engine parity with ``src/training/training_loop.py``
+(SURVEY.md §3.1), re-shaped for the JAX async-dispatch model.
+
+Per iteration: one D step and one G step (alternating, two separate Adam
+optimizers — "two-timescale", BASELINE.json:5), with the lazy-reg variants
+(R1 every ``d_reg_interval``, path-length every ``g_reg_interval``) selected
+*in Python* from the static step index so each variant is its own jit
+specialization (SURVEY.md §7.3 item 2).
+
+Throughput discipline (the ≥200 img/sec/chip target dies on host syncs —
+§7.3 item 4): device metrics are only fetched at tick boundaries; the step
+functions donate the state pytree, so the loop body enqueues work and
+immediately continues.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from gansformer_tpu.core.config import ExperimentConfig
+from gansformer_tpu.data.dataset import make_dataset
+from gansformer_tpu.parallel.mesh import MeshEnv, local_batch_size, make_mesh
+from gansformer_tpu.train import checkpoint as ckpt
+from gansformer_tpu.train.state import TrainState, create_train_state, param_count
+from gansformer_tpu.train.steps import make_train_steps
+from gansformer_tpu.utils.image import save_image_grid
+from gansformer_tpu.utils.logging import RunLogger
+
+
+def train(cfg: ExperimentConfig, run_dir: str,
+          env: Optional[MeshEnv] = None,
+          resume: bool = False,
+          total_kimg: Optional[int] = None,
+          logger: Optional[RunLogger] = None) -> TrainState:
+    t = cfg.train
+    env = env or make_mesh(cfg.mesh)
+    log = logger or RunLogger(run_dir)
+    total_kimg = total_kimg if total_kimg is not None else t.total_kimg
+
+    n_chips = env.mesh.size
+    log.write(f"mesh: {dict(zip(env.mesh.axis_names, env.mesh.devices.shape))} "
+              f"({n_chips} devices, {jax.process_count()} processes)")
+    log.write(f"config: {cfg.name}  resolution {cfg.model.resolution}  "
+              f"attention {cfg.model.attention}  k={cfg.model.components}")
+
+    # --- state ---------------------------------------------------------------
+    rng = jax.random.PRNGKey(t.seed)
+    state = create_train_state(cfg, rng)
+    log.write(f"G params: {param_count(state.g_params):,}  "
+              f"D params: {param_count(state.d_params):,}")
+    ckpt_dir = os.path.join(run_dir, "checkpoints")
+    if resume:
+        last = ckpt.latest_step(ckpt_dir)
+        if last is not None:
+            state = ckpt.restore(ckpt_dir, state)
+            log.write(f"resumed from step {last} ({last / 1000:.1f} kimg)")
+
+    # replicate state across the mesh; batches arrive sharded on 'data'
+    state = jax.device_put(state, env.replicated())
+    fns = make_train_steps(cfg, env, batch_size=t.batch_size)
+
+    # --- data ----------------------------------------------------------------
+    dataset = make_dataset(cfg.data)
+    shard = (jax.process_index(), jax.process_count())
+    # Each process produces only its share of the global batch; the global
+    # array is assembled from process-local shards (no cross-host shuffle —
+    # SURVEY.md §7.3 item 6).
+    multihost = jax.process_count() > 1
+    local_bs = local_batch_size(t.batch_size, env) if multihost else t.batch_size
+    batches = dataset.batches(local_bs, seed=t.seed + 1, shard=shard)
+    batch_sharding = env.batch()
+
+    def put_batch(host_imgs: np.ndarray) -> jax.Array:
+        if multihost:
+            return jax.make_array_from_process_local_data(
+                batch_sharding, host_imgs)
+        return jax.device_put(host_imgs, batch_sharding)
+
+    # --- fixed grid latents for snapshots ------------------------------------
+    grid_n = min(16, t.batch_size * 2)
+    grid_z = jax.random.normal(
+        jax.random.PRNGKey(t.seed + 2),
+        (grid_n, cfg.model.num_ws, cfg.model.latent_dim), np.float32)
+    noise_key = jax.random.PRNGKey(t.seed + 3)
+
+    def snapshot_images(st: TrainState, kimg: float) -> None:
+        imgs = fns.sample(st.ema_params, st.w_avg, grid_z, noise_key,
+                          truncation_psi=0.7)
+        save_image_grid(np.asarray(jax.device_get(imgs)),
+                        os.path.join(run_dir, f"fakes{int(kimg):06d}.png"))
+
+    metric_group = None  # built lazily once; Inception init/jit is costly
+
+    def run_metrics(st: TrainState):
+        """Per-snapshot metric runs — reference training_loop parity
+        (SURVEY.md §3.1 'periodic metric runs')."""
+        nonlocal metric_group
+        if metric_group is None:
+            from gansformer_tpu.metrics.metric_base import (
+                MetricGroup, parse_metric_names)
+
+            metric_group = MetricGroup(
+                parse_metric_names(t.metrics, batch_size=t.batch_size),
+                cache_dir=os.path.join(run_dir, "metric-cache"))
+        group = metric_group
+        rng_holder = [jax.random.PRNGKey(t.seed + 5)]
+
+        def sample_fn(n):
+            rng_holder[0], k1, k2 = jax.random.split(rng_holder[0], 3)
+            z = jax.random.normal(
+                k1, (n, cfg.model.num_ws, cfg.model.latent_dim))
+            return fns.sample(st.ema_params, st.w_avg, z, k2,
+                              truncation_psi=1.0)
+
+        return group.run(sample_fn, dataset)
+
+    # --- loop ----------------------------------------------------------------
+    cur_nimg = int(jax.device_get(state.step))
+    it = cur_nimg // t.batch_size
+    tick = 0
+    tick_start_nimg = cur_nimg
+    tick_start_time = time.time()
+    last_metrics = {}
+    snapshot_images(state, cur_nimg / 1000)
+
+    while cur_nimg < total_kimg * 1000:
+        batch = next(batches)
+        imgs = put_batch(batch["image"])
+        step_rng = jax.random.fold_in(jax.random.PRNGKey(t.seed + 4), it)
+
+        d_fn = fns.d_step_r1 if (it % t.d_reg_interval == 0) else fns.d_step
+        state, d_aux = d_fn(state, imgs, jax.random.fold_in(step_rng, 0))
+        g_fn = fns.g_step_pl if (it % t.g_reg_interval == 0) else fns.g_step
+        state, g_aux = g_fn(state, jax.random.fold_in(step_rng, 1))
+
+        it += 1
+        cur_nimg += t.batch_size
+        last_metrics = {**d_aux, **g_aux}
+
+        # --- tick boundary (the ONLY host sync) -----------------------------
+        if cur_nimg >= tick_start_nimg + t.kimg_per_tick * 1000 or \
+                cur_nimg >= total_kimg * 1000:
+            jax.block_until_ready(state.step)
+            now = time.time()
+            sec_per_tick = now - tick_start_time
+            imgs_done = cur_nimg - tick_start_nimg
+            fetched = {k: float(jax.device_get(v))
+                       for k, v in last_metrics.items()}
+            stats = {
+                "Progress/tick": tick,
+                "Progress/kimg": cur_nimg / 1000,
+                "timing/sec_per_tick": sec_per_tick,
+                "timing/img_per_sec": imgs_done / max(sec_per_tick, 1e-9),
+                "timing/img_per_sec_per_chip":
+                    imgs_done / max(sec_per_tick, 1e-9) / n_chips,
+                **fetched,
+            }
+            log.log_tick(stats)
+            tick += 1
+            tick_start_nimg = cur_nimg
+            tick_start_time = time.time()
+
+            if tick % t.image_snapshot_ticks == 0:
+                snapshot_images(state, cur_nimg / 1000)
+            if tick % t.snapshot_ticks == 0:
+                # Orbax save() runs a cross-host barrier internally — every
+                # process must call it (gating on process 0 would deadlock
+                # a multi-host run).
+                ckpt.save(ckpt_dir, state, cfg)
+                log.write(f"checkpoint @ {cur_nimg / 1000:.1f} kimg")
+            if t.metric_ticks > 0 and t.metrics and tick % t.metric_ticks == 0:
+                results = run_metrics(state)
+                for name, val in results.items():
+                    log.metric(name, val, cur_nimg / 1000)
+                log.write("metrics @ {:.1f} kimg: {}".format(
+                    cur_nimg / 1000,
+                    {k: round(v, 3) for k, v in results.items()}))
+
+    # final snapshot + checkpoint
+    snapshot_images(state, cur_nimg / 1000)
+    ckpt.save(ckpt_dir, state, cfg)
+    log.write(f"done: {cur_nimg / 1000:.1f} kimg")
+    return state

@@ -1,0 +1,86 @@
+"""Train state — everything the training loop owns, as one pytree.
+
+The reference scatters this across TF1 graph variables: G/D vars inside
+``tflib.Network`` objects, Adam slots inside ``tflib.Optimizer``, the EMA
+clone ``Gs``, ``w_avg``/``pl_mean`` as graph vars, and kimg accounting in
+Python (SURVEY.md §2.2, §3.1).  Here it is a single ``flax.struct`` pytree:
+jit-donatable, orbax-checkpointable as a unit (deliberately *better* than the
+reference, which silently drops Adam moments on resume — SURVEY.md §7.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from gansformer_tpu.core.config import ExperimentConfig
+from gansformer_tpu.models.discriminator import Discriminator
+from gansformer_tpu.models.generator import Generator
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array                  # int32 scalar; cur_nimg = step * batch
+    g_params: Any
+    d_params: Any
+    g_opt: Any                       # optax state (two-timescale: separate)
+    d_opt: Any
+    ema_params: Any                  # Gs — EMA generator used for all eval
+    w_avg: jax.Array                 # [w_dim] mapping-output EMA (truncation)
+    pl_mean: jax.Array               # scalar path-length EMA
+
+    @property
+    def cur_nimg(self):
+        return self.step
+
+
+def lazy_adam(lr: float, beta1: float, beta2: float, eps: float,
+              reg_interval: int) -> optax.GradientTransformation:
+    """Adam with lazy-regularization coefficient correction.
+
+    When a regularizer only fires every ``I`` steps the reference rescales
+    lr and betas by ``c = I/(I+1)`` (StyleGAN2's lazy-reg trick) so the
+    effective optimization trajectory matches a per-step regularizer.
+    """
+    c = reg_interval / (reg_interval + 1.0)
+    return optax.adam(lr * c, b1=beta1**c, b2=beta2**c, eps=eps)
+
+
+def make_optimizers(cfg: ExperimentConfig):
+    t = cfg.train
+    g_tx = lazy_adam(t.g_lr, t.adam_beta1, t.adam_beta2, t.adam_eps,
+                     t.g_reg_interval)
+    d_tx = lazy_adam(t.d_lr, t.adam_beta1, t.adam_beta2, t.adam_eps,
+                     t.d_reg_interval)
+    return g_tx, d_tx
+
+
+def create_train_state(cfg: ExperimentConfig, rng: jax.Array) -> TrainState:
+    m = cfg.model
+    G = Generator(m)
+    D = Discriminator(m)
+    k_g, k_d, k_noise = jax.random.split(rng, 3)
+    z = jnp.zeros((2, m.num_ws, m.latent_dim), jnp.float32)
+    img = jnp.zeros((2, m.resolution, m.resolution, m.img_channels), jnp.float32)
+    g_vars = G.init({"params": k_g, "noise": k_noise}, z)
+    d_vars = D.init({"params": k_d}, img)
+    g_params, d_params = g_vars["params"], d_vars["params"]
+    g_tx, d_tx = make_optimizers(cfg)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        g_params=g_params,
+        d_params=d_params,
+        g_opt=g_tx.init(g_params),
+        d_opt=d_tx.init(d_params),
+        ema_params=jax.tree_util.tree_map(jnp.copy, g_params),
+        w_avg=jnp.zeros((m.w_dim,), jnp.float32),
+        pl_mean=jnp.zeros((), jnp.float32),
+    )
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,206 @@
+"""Jitted train steps — the SPMD replacement for the reference's hot loop.
+
+Reference (SURVEY.md §3.1): per-GPU towers registered on two ``tflib.
+Optimizer``\\ s, an NCCL all-reduce at ``apply_updates()``, and a Python
+``sess.run`` pair per iteration, with lazy-reg variants of the train ops run
+every N steps.
+
+TPU-native design:
+* ONE function per phase combination — ``(d, d+r1, g, g+pl)`` — each a
+  separate jit specialization selected in Python by ``step % interval``
+  (static dispatch; no recompile churn — SURVEY.md §7.3 item 2).
+* Data parallelism is invisible: the batch arrives sharded over the ``data``
+  mesh axis, params replicated; XLA turns the loss mean into a ``psum`` over
+  ICI.  No gradient-all-reduce code exists anywhere.
+* State is donated: params/opt-state buffers are updated in place in HBM.
+* Style mixing (reference ``style_mixing_prob``) swaps a random suffix of
+  latent components to a second mapping pass — implemented with a
+  per-sample ``where`` mask (no data-dependent control flow under jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from gansformer_tpu.core.config import ExperimentConfig
+from gansformer_tpu.data.dataset import normalize_images
+from gansformer_tpu.losses.gan import (
+    d_logistic_loss,
+    g_nonsaturating_loss,
+    path_length_penalty,
+    r1_penalty,
+)
+from gansformer_tpu.models.discriminator import Discriminator
+from gansformer_tpu.models.generator import Generator
+from gansformer_tpu.parallel.mesh import MeshEnv
+from gansformer_tpu.train.state import TrainState, make_optimizers
+
+Metrics = Dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainStepFns:
+    """The four jitted step functions + eval-time samplers."""
+
+    d_step: Callable[[TrainState, Any, jax.Array], Tuple[TrainState, Metrics]]
+    d_step_r1: Callable[[TrainState, Any, jax.Array], Tuple[TrainState, Metrics]]
+    g_step: Callable[[TrainState, jax.Array], Tuple[TrainState, Metrics]]
+    g_step_pl: Callable[[TrainState, jax.Array], Tuple[TrainState, Metrics]]
+    # Generator sampler (params, w_avg, z, rng, truncation_psi) — pass
+    # ``ema_params`` for eval (the Gs path) or ``g_params`` for debug grids.
+    sample: Callable[..., jax.Array]
+    sample_train: Callable[..., jax.Array]    # alias of ``sample``
+
+
+def _sample_z(cfg, rng, batch):
+    m = cfg.model
+    return jax.random.normal(rng, (batch, m.num_ws, m.latent_dim), jnp.float32)
+
+
+def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
+                     batch_size: Optional[int] = None) -> TrainStepFns:
+    m, t = cfg.model, cfg.train
+    G = Generator(m)
+    D = Discriminator(m)
+    g_tx, d_tx = make_optimizers(cfg)
+    batch = batch_size if batch_size is not None else t.batch_size
+    w_avg_beta = 0.995
+
+    def ema_beta_at(step: jax.Array) -> jax.Array:
+        """Per-step EMA decay from the half-life in kimg (reference
+        ema_kimg), with the optional ramp-up cap (reference ema_rampup:
+        half-life grows with cur_nimg early in training)."""
+        ema_nimg = jnp.asarray(t.ema_kimg * 1000.0, jnp.float32)
+        if t.ema_rampup is not None:
+            ema_nimg = jnp.minimum(
+                ema_nimg, step.astype(jnp.float32) * t.ema_rampup)
+        return 0.5 ** (batch / jnp.maximum(ema_nimg, 1e-8))
+
+    def g_forward(g_params, z, noise_rng, mix_rng=None):
+        """Mapping (+ style mixing) + synthesis; returns (imgs, ws)."""
+        ws = G.apply({"params": g_params}, z, method=Generator.map)
+        if mix_rng is not None and t.style_mixing_prob > 0:
+            k_z, k_cut, k_p = jax.random.split(mix_rng, 3)
+            z2 = jax.random.normal(k_z, z.shape, z.dtype)
+            ws2 = G.apply({"params": g_params}, z2, method=Generator.map)
+            n, num_ws = ws.shape[0], ws.shape[1]
+            # per-sample crossover component index; prob-gated
+            cut = jax.random.randint(k_cut, (n, 1), 1, num_ws)
+            do_mix = jax.random.uniform(k_p, (n, 1)) < t.style_mixing_prob
+            comp = jnp.arange(num_ws)[None, :]
+            mask = (comp >= cut) & do_mix                       # [n, num_ws]
+            ws = jnp.where(mask[..., None], ws2, ws)
+        imgs = G.apply({"params": g_params}, ws, rngs={"noise": noise_rng},
+                       method=Generator.synthesize)
+        return imgs, ws
+
+    # ---------------- D steps ----------------
+
+    def d_loss_fn(d_params, g_params, reals, z, rng, do_r1: bool):
+        k_noise, k_mix = jax.random.split(jax.random.fold_in(rng, 1))
+        fakes, _ = g_forward(g_params, z, k_noise, k_mix)
+        fakes = jax.lax.stop_gradient(fakes)
+        real_logits = D.apply({"params": d_params}, reals)
+        fake_logits = D.apply({"params": d_params}, fakes)
+        loss = d_logistic_loss(real_logits, fake_logits)
+        aux = {
+            "Loss/D": loss,
+            "Loss/scores/real": jnp.mean(real_logits),
+            "Loss/scores/fake": jnp.mean(fake_logits),
+        }
+        if do_r1:
+            r1 = r1_penalty(lambda x: D.apply({"params": d_params}, x), reals)
+            aux["Loss/D/r1"] = r1
+            # lazy reg: scale by interval so the *time-averaged* strength
+            # matches an every-step penalty (reference trick).
+            loss = loss + (t.r1_gamma * 0.5) * r1 * t.d_reg_interval
+        return loss, aux
+
+    def _d_step(state: TrainState, batch_imgs, rng, do_r1: bool):
+        reals = normalize_images(batch_imgs)
+        if cfg.data.mirror_augment:
+            flip = jax.random.bernoulli(
+                jax.random.fold_in(rng, 7), 0.5, (reals.shape[0], 1, 1, 1))
+            reals = jnp.where(flip, reals[:, :, ::-1, :], reals)
+        z = _sample_z(cfg, jax.random.fold_in(rng, 0), reals.shape[0])
+        grad_fn = jax.value_and_grad(d_loss_fn, has_aux=True)
+        (_, aux), grads = grad_fn(state.d_params, state.g_params, reals, z,
+                                  rng, do_r1)
+        updates, d_opt = d_tx.update(grads, state.d_opt, state.d_params)
+        d_params = optax.apply_updates(state.d_params, updates)
+        return state.replace(d_params=d_params, d_opt=d_opt), aux
+
+    # ---------------- G steps ----------------
+
+    def g_loss_fn(g_params, d_params, z, rng, pl_mean, do_pl: bool):
+        k_noise, k_mix = jax.random.split(jax.random.fold_in(rng, 2))
+        fakes, ws = g_forward(g_params, z, k_noise, k_mix)
+        fake_logits = D.apply({"params": d_params}, fakes)
+        loss = g_nonsaturating_loss(fake_logits)
+        aux = {"Loss/G": loss}
+        new_pl_mean = pl_mean
+        if do_pl:
+            # Reference shrinks the PL batch (pl_batch_shrink) to bound cost
+            # and draws fresh latents for the probe.
+            pl_batch = max(1, ws.shape[0] // max(1, t.pl_batch_shrink))
+            k_pl, k_plnoise = jax.random.split(jax.random.fold_in(rng, 3))
+            z_pl = _sample_z(cfg, k_pl, pl_batch)
+            ws_pl = G.apply({"params": g_params}, z_pl, method=Generator.map)
+
+            def synth(w):
+                return G.apply({"params": g_params}, w,
+                               rngs={"noise": jax.random.fold_in(rng, 4)},
+                               method=Generator.synthesize)
+
+            pl, new_pl_mean = path_length_penalty(
+                synth, ws_pl, pl_mean, k_plnoise, t.pl_decay)
+            aux["Loss/G/pl"] = pl
+            loss = loss + t.pl_weight * pl * t.g_reg_interval
+        w_batch_avg = jnp.mean(
+            jax.lax.stop_gradient(ws).astype(jnp.float32), axis=(0, 1))
+        return loss, (aux, new_pl_mean, w_batch_avg)
+
+    def _g_step(state: TrainState, rng, do_pl: bool):
+        z = _sample_z(cfg, jax.random.fold_in(rng, 5), batch)
+        grad_fn = jax.value_and_grad(g_loss_fn, has_aux=True)
+        (_, (aux, new_pl_mean, w_batch_avg)), grads = grad_fn(
+            state.g_params, state.d_params, z, rng, state.pl_mean, do_pl)
+        updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)
+        g_params = optax.apply_updates(state.g_params, updates)
+        ema_beta = ema_beta_at(state.step)
+        ema_params = jax.tree_util.tree_map(
+            lambda e, p: e * ema_beta + p * (1.0 - ema_beta),
+            state.ema_params, g_params)
+        w_avg = state.w_avg * w_avg_beta + w_batch_avg * (1.0 - w_avg_beta)
+        return state.replace(
+            step=state.step + batch,   # step counts images (kimg accounting)
+            g_params=g_params, g_opt=g_opt, ema_params=ema_params,
+            w_avg=w_avg, pl_mean=new_pl_mean), aux
+
+    # ---------------- samplers ----------------
+
+    def _sample(params, w_avg, z, rng, truncation_psi: float):
+        ws = G.apply({"params": params}, z, method=Generator.map)
+        if truncation_psi != 1.0:
+            ws = w_avg[None, None, :] + truncation_psi * (
+                ws - w_avg[None, None, :])
+        return G.apply({"params": params}, ws, rngs={"noise": rng},
+                       method=Generator.synthesize)
+
+    donate_state = dict(donate_argnums=(0,))
+    sample = jax.jit(_sample, static_argnames=("truncation_psi",))
+    fns = TrainStepFns(
+        d_step=jax.jit(functools.partial(_d_step, do_r1=False), **donate_state),
+        d_step_r1=jax.jit(functools.partial(_d_step, do_r1=True), **donate_state),
+        g_step=jax.jit(functools.partial(_g_step, do_pl=False), **donate_state),
+        g_step_pl=jax.jit(functools.partial(_g_step, do_pl=True), **donate_state),
+        sample=sample,
+        sample_train=sample,
+    )
+    return fns

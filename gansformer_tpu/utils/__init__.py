@@ -1,0 +1,2 @@
+from gansformer_tpu.utils.image import save_image_grid, to_uint8
+from gansformer_tpu.utils.logging import RunLogger

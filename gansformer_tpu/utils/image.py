@@ -1,0 +1,42 @@
+"""Image grid saving — the reference's ``misc.save_image_grid`` (SURVEY.md
+§2.2 "Misc/vis utils"): every tick the loop writes ``fakes<kimg>.png`` so a
+human can eyeball training health (the reference's primary "test" — §4)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def to_uint8(images: np.ndarray, drange: Tuple[float, float] = (-1, 1)) -> np.ndarray:
+    """float [N,H,W,C] in drange → uint8."""
+    lo, hi = drange
+    img = (np.asarray(images, dtype=np.float32) - lo) * (255.0 / (hi - lo))
+    return np.clip(np.rint(img), 0, 255).astype(np.uint8)
+
+
+def make_grid(images: np.ndarray, grid: Optional[Tuple[int, int]] = None) -> np.ndarray:
+    """[N,H,W,C] uint8 → one [GH*H, GW*W, C] tile image."""
+    n, h, w, c = images.shape
+    if grid is None:
+        gw = max(1, int(math.sqrt(n)))
+        gh = (n + gw - 1) // gw
+    else:
+        gw, gh = grid
+    canvas = np.zeros((gh * h, gw * w, c), dtype=np.uint8)
+    for i in range(min(n, gw * gh)):
+        r, col = divmod(i, gw)
+        canvas[r * h:(r + 1) * h, col * w:(col + 1) * w] = images[i]
+    return canvas
+
+
+def save_image_grid(images, path: str, drange: Tuple[float, float] = (-1, 1),
+                    grid: Optional[Tuple[int, int]] = None) -> None:
+    from PIL import Image
+
+    arr = make_grid(to_uint8(np.asarray(images), drange), grid)
+    if arr.shape[-1] == 1:
+        arr = arr[..., 0]
+    Image.fromarray(arr).save(path)

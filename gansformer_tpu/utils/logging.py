@@ -1,0 +1,110 @@
+"""Run-dir logging & observability.
+
+Replaces the reference's trio of ``autosummary`` → TensorBoard events,
+``log.txt`` stdout tee, and per-tick console lines (SURVEY.md §5
+"Metrics / logging").  Design: one structured per-tick dict goes to
+(1) the console in the reference's one-line format, (2) ``stats.jsonl``
+(machine-readable; supersedes TB events with no TF dependency), and
+(3) scalar names kept reference-compatible (``Loss/G``, ``Progress/kimg``,
+``timing/img_per_sec_per_chip``) so dashboards translate 1:1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+
+def append_metric_line(run_dir: str, name: str, value: float,
+                       kimg: float) -> None:
+    """The one place that knows the metric-<name>.txt line format
+    (reference convention, SURVEY.md §3.3)."""
+    with open(os.path.join(run_dir, f"metric-{name}.txt"), "a") as f:
+        f.write(f"kimg {kimg:<10.1f} {name} {value:.6f}\n")
+
+
+class RunLogger:
+    """Run-dir writer.  ``active=False`` (non-zero process index in a
+    multi-host run) turns every write into a no-op so only one host owns
+    the run dir's files."""
+
+    def __init__(self, run_dir: str, active: bool = True):
+        self.run_dir = run_dir
+        self.active = active
+        if active:
+            os.makedirs(run_dir, exist_ok=True)
+            self.jsonl = open(os.path.join(run_dir, "stats.jsonl"), "a")
+            self.log_file = open(os.path.join(run_dir, "log.txt"), "a")
+        self.t0 = time.time()
+
+    def log_tick(self, stats: Dict[str, float]) -> None:
+        if not self.active:
+            return
+        rec = {"time": round(time.time() - self.t0, 2), **{
+            k: (round(float(v), 6) if isinstance(v, (int, float)) else v)
+            for k, v in stats.items()}}
+        self.jsonl.write(json.dumps(rec) + "\n")
+        self.jsonl.flush()
+        line = ("tick {tick:<5d} kimg {kimg:<8.1f} "
+                "time {time:<8.1f} sec/tick {sec_tick:<7.1f} "
+                "img/s {imgs:<8.1f} G {g:<6.3f} D {d:<6.3f}").format(
+            tick=int(stats.get("Progress/tick", 0)),
+            kimg=stats.get("Progress/kimg", 0.0),
+            time=rec["time"],
+            sec_tick=stats.get("timing/sec_per_tick", 0.0),
+            imgs=stats.get("timing/img_per_sec", 0.0),
+            g=stats.get("Loss/G", float("nan")),
+            d=stats.get("Loss/D", float("nan")))
+        self.write(line)
+
+    def write(self, msg: str) -> None:
+        if not self.active:
+            return
+        print(msg)
+        sys.stdout.flush()
+        self.log_file.write(msg + "\n")
+        self.log_file.flush()
+
+    def metric(self, name: str, value: float, kimg: float) -> None:
+        if not self.active:
+            return
+        append_metric_line(self.run_dir, name, value, kimg)
+
+    def close(self) -> None:
+        if self.active:
+            self.jsonl.close()
+            self.log_file.close()
+
+
+def list_run_dirs(results_root: str):
+    """Numbered run dirs under results_root, sorted by run id."""
+    if not os.path.isdir(results_root):
+        return []
+    return sorted(
+        os.path.join(results_root, d) for d in os.listdir(results_root)
+        if os.path.isdir(os.path.join(results_root, d))
+        and d.split("-")[0].isdigit())
+
+
+def next_run_id(results_root: str) -> int:
+    existing = [int(os.path.basename(d).split("-")[0])
+                for d in list_run_dirs(results_root)]
+    return max(existing, default=-1) + 1
+
+
+def create_run_dir(results_root: str, desc: str,
+                   run_id: Optional[int] = None, create: bool = True) -> str:
+    """Numbered run dirs — reference ``results/00012-<desc>/`` convention
+    (SURVEY.md §2.2 "Submit/run framework").  Multi-host runs pass an
+    explicit ``run_id`` (agreed via broadcast) and ``create=False`` on
+    non-zero processes so only one host touches the filesystem."""
+    if run_id is None:
+        os.makedirs(results_root, exist_ok=True)
+        run_id = next_run_id(results_root)
+    run_dir = os.path.join(results_root, f"{run_id:05d}-{desc}")
+    if create:
+        os.makedirs(run_dir, exist_ok=True)
+    return run_dir

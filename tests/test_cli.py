@@ -1,0 +1,58 @@
+"""CLI surface tests — config construction only (no training)."""
+
+import json
+
+import pytest
+
+from gansformer_tpu.cli.train import build_parser, config_from_args
+from gansformer_tpu.core.config import ExperimentConfig, get_preset, PRESETS
+
+
+def test_presets_cover_driver_configs():
+    # the five driver benchmark configs (BASELINE.json:7-11)
+    assert set(PRESETS) == {
+        "clevr64-simplex", "ffhq256-duplex", "bedroom256-duplex",
+        "cityscapes256-duplex", "ffhq1024-duplex"}
+    assert PRESETS["clevr64-simplex"].model.components == 8
+    assert PRESETS["ffhq256-duplex"].model.components == 16
+    assert PRESETS["cityscapes256-duplex"].model.components == 32
+    assert PRESETS["ffhq1024-duplex"].model.resolution == 1024
+
+
+def test_config_json_roundtrip():
+    cfg = get_preset("ffhq256-duplex")
+    back = ExperimentConfig.from_json(cfg.to_json())
+    assert back == cfg
+
+
+def test_cli_overrides():
+    args = build_parser().parse_args([
+        "--preset", "ffhq256-duplex", "--batch-size", "64",
+        "--attention", "simplex", "--components", "8",
+        "--total-kimg", "5", "--data-source", "synthetic"])
+    cfg = config_from_args(args)
+    assert cfg.train.batch_size == 64
+    assert cfg.model.attention == "simplex"
+    assert cfg.model.components == 8
+    assert cfg.train.total_kimg == 5
+    assert cfg.data.source == "synthetic"
+    # untouched fields keep preset values
+    assert cfg.model.resolution == 256
+
+
+def test_cli_defaults_valid():
+    for name in PRESETS:
+        args = build_parser().parse_args(["--preset", name])
+        cfg = config_from_args(args)
+        assert cfg.model.resolution == PRESETS[name].model.resolution
+
+
+def test_prepare_data_synthetic(tmp_path):
+    from gansformer_tpu.cli.prepare_data import main
+    import numpy as np
+
+    out = tmp_path / "toy.npz"
+    main(["--synthetic", "--out", str(out), "--resolution", "16",
+          "--max-images", "12"])
+    with np.load(out) as z:
+        assert z["images"].shape == (12, 16, 16, 3)

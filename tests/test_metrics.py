@@ -1,0 +1,92 @@
+"""Metric pipeline tests — closed-form Fréchet distance on synthetic
+Gaussians (SURVEY.md §4 'Implication for the TPU build')."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gansformer_tpu.metrics.fid import (
+    compute_activation_stats,
+    fid_from_features,
+    frechet_distance,
+    sqrtm_newton_schulz,
+)
+from gansformer_tpu.metrics.inception_score import inception_score
+
+
+def test_frechet_distance_identical_is_zero():
+    mu = np.zeros(8)
+    sigma = np.eye(8)
+    assert abs(frechet_distance(mu, sigma, mu, sigma)) < 1e-8
+
+
+def test_frechet_distance_closed_form_means():
+    # equal covariances → d² = ||μ₁-μ₂||²
+    sigma = np.eye(4) * 2.0
+    mu1, mu2 = np.zeros(4), np.array([1.0, 2.0, 0.0, 0.0])
+    np.testing.assert_allclose(
+        frechet_distance(mu1, sigma, mu2, sigma), 5.0, rtol=1e-6)
+
+
+def test_frechet_distance_closed_form_diag():
+    # diagonal Σ → d² = Σᵢ (√σ1ᵢ - √σ2ᵢ)²  (means equal)
+    s1 = np.diag([1.0, 4.0])
+    s2 = np.diag([9.0, 16.0])
+    expect = (1 - 3) ** 2 + (2 - 4) ** 2
+    np.testing.assert_allclose(
+        frechet_distance(np.zeros(2), s1, np.zeros(2), s2), expect, rtol=1e-6)
+
+
+def test_sqrtm_newton_schulz_matches_eig():
+    rs = np.random.RandomState(0)
+    a = rs.randn(16, 16)
+    psd = (a @ a.T + 16 * np.eye(16)).astype(np.float32)
+    got = np.asarray(sqrtm_newton_schulz(jnp.asarray(psd)))
+    np.testing.assert_allclose(got @ got, psd, rtol=2e-3, atol=2e-3)
+
+
+def test_fid_from_samples_statistical():
+    rs = np.random.RandomState(1)
+    a = rs.randn(4000, 16)
+    b = rs.randn(4000, 16) + 1.0  # shifted → d² ≈ 16
+    same = fid_from_features(a, rs.randn(4000, 16))
+    diff = fid_from_features(a, b)
+    assert same < 1.0
+    assert abs(diff - 16.0) < 2.0
+
+
+def test_inception_score_bounds():
+    rs = np.random.RandomState(2)
+    n, c = 1000, 10
+    # one-hot-confident uniform-over-classes logits → IS ≈ num classes
+    classes = rs.randint(0, c, n)
+    logits = np.full((n, c), -20.0)
+    logits[np.arange(n), classes] = 20.0
+    mean, _ = inception_score(logits, splits=5)
+    assert mean > c * 0.8
+    # constant logits → IS = 1
+    mean, _ = inception_score(np.zeros((n, c)), splits=5)
+    np.testing.assert_allclose(mean, 1.0, rtol=1e-6)
+
+
+def test_metric_group_on_tiny_extractor():
+    """End-to-end FID/IS machinery with the uncalibrated extractor on tiny
+    images — pipeline correctness, not FID values."""
+    from gansformer_tpu.data.dataset import SyntheticDataset
+    from gansformer_tpu.metrics.inception import FeatureExtractor
+    from gansformer_tpu.metrics.metric_base import FIDMetric, ISMetric, MetricGroup
+
+    ds = SyntheticDataset(resolution=32, num_images=64)
+    ex = FeatureExtractor(None)  # deterministic random init
+    group = MetricGroup([FIDMetric(num_images=16, batch_size=8),
+                         ISMetric(num_images=16, batch_size=8, splits=2)],
+                        extractor=ex)
+
+    rs = np.random.RandomState(3)
+
+    def sample_fn(n):
+        return jnp.asarray(rs.rand(n, 32, 32, 3).astype(np.float32) * 2 - 1)
+
+    out = group.run(sample_fn, ds)
+    assert np.isfinite(out["fid16"]) and out["fid16"] >= 0
+    assert out["is16_mean"] >= 1.0
+    assert out["calibrated"] == 0.0

@@ -1,0 +1,148 @@
+"""Training-engine tests on the 8-device CPU mesh (SURVEY.md §4): real
+sharded steps, all four lazy-reg phase variants, EMA, checkpoint round-trip.
+Shapes are micro to bound compile time."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gansformer_tpu.core.config import (
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, TrainConfig)
+from gansformer_tpu.parallel.mesh import make_mesh
+from gansformer_tpu.train.state import create_train_state, param_count
+from gansformer_tpu.train.steps import make_train_steps
+
+
+def micro_cfg(attention="simplex", batch=8):
+    return ExperimentConfig(
+        name="micro",
+        model=ModelConfig(resolution=16, components=2, latent_dim=16,
+                          w_dim=16, mapping_dim=16, mapping_layers=2,
+                          fmap_base=64, fmap_max=32, attention=attention,
+                          attn_start_res=8, attn_max_res=8, mbstd_group_size=4),
+        train=TrainConfig(batch_size=batch, total_kimg=1, d_reg_interval=2,
+                          g_reg_interval=2, pl_batch_shrink=2,
+                          ema_kimg=0.01, style_mixing_prob=0.5),
+        data=DataConfig(resolution=16, source="synthetic"),
+        mesh=MeshConfig(),
+    )
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """Run 4 full iterations (covers all 4 phase variants) once; reuse."""
+    cfg = micro_cfg()
+    env = make_mesh(cfg.mesh)
+    state = create_train_state(cfg, jax.random.PRNGKey(0))
+    state = jax.device_put(state, env.replicated())
+    fns = make_train_steps(cfg, env, batch_size=cfg.train.batch_size)
+    imgs = jax.device_put(
+        np.random.RandomState(0).randint(
+            0, 255, (cfg.train.batch_size, 16, 16, 3), dtype=np.uint8),
+        env.batch())
+    rng = jax.random.PRNGKey(1)
+    auxes = []
+    for it in range(4):
+        d_fn = fns.d_step_r1 if it % 2 == 0 else fns.d_step
+        g_fn = fns.g_step_pl if it % 2 == 0 else fns.g_step
+        state, d_aux = d_fn(state, imgs, jax.random.fold_in(rng, 2 * it))
+        state, g_aux = g_fn(state, jax.random.fold_in(rng, 2 * it + 1))
+        auxes.append({**d_aux, **g_aux})
+    jax.block_until_ready(state.step)
+    return cfg, env, fns, state, auxes
+
+
+def test_losses_finite_all_variants(trained):
+    _, _, _, _, auxes = trained
+    for aux in auxes:
+        for k, v in aux.items():
+            assert np.isfinite(float(jax.device_get(v))), k
+    assert "Loss/D/r1" in auxes[0] and "Loss/G/pl" in auxes[0]
+    assert "Loss/D/r1" not in auxes[1]
+
+
+def test_step_counts_images(trained):
+    cfg, _, _, state, _ = trained
+    assert int(jax.device_get(state.step)) == 4 * cfg.train.batch_size
+
+
+def test_ema_and_pl_mean_updated(trained):
+    _, _, _, state, _ = trained
+    assert float(jax.device_get(state.pl_mean)) > 0
+    diff = jax.tree_util.tree_map(
+        lambda e, p: float(jnp.max(jnp.abs(e - p))),
+        state.ema_params, state.g_params)
+    assert max(jax.tree_util.tree_leaves(diff)) > 0  # EMA lags G
+
+
+def test_params_changed_and_finite(trained):
+    cfg, _, _, state, _ = trained
+    fresh = create_train_state(cfg, jax.random.PRNGKey(0))
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - jnp.asarray(b)))),
+        jax.device_get(state.g_params), fresh.g_params)
+    assert max(jax.tree_util.tree_leaves(moved)) > 1e-6
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(state.g_params)):
+        assert np.all(np.isfinite(leaf))
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(state.d_params)):
+        assert np.all(np.isfinite(leaf))
+
+
+def test_sampler_truncation(trained):
+    cfg, _, fns, state, _ = trained
+    z = jax.random.normal(jax.random.PRNGKey(5),
+                          (4, cfg.model.num_ws, cfg.model.latent_dim))
+    k = jax.random.PRNGKey(6)
+    full = fns.sample(state.ema_params, state.w_avg, z, k, truncation_psi=1.0)
+    trunc = fns.sample(state.ema_params, state.w_avg, z, k, truncation_psi=0.5)
+    assert full.shape == (4, 16, 16, 3)
+    assert not np.allclose(np.asarray(full), np.asarray(trunc))
+
+
+def test_checkpoint_roundtrip(trained, tmp_path):
+    cfg, _, _, state, _ = trained
+    from gansformer_tpu.train import checkpoint as ckpt
+
+    host_state = jax.device_get(state)
+    ckpt.save(str(tmp_path / "ck"), host_state, cfg)
+    assert ckpt.latest_step(str(tmp_path / "ck")) == int(host_state.step)
+    template = create_train_state(cfg, jax.random.PRNGKey(0))
+    restored = ckpt.restore(str(tmp_path / "ck"), template)
+    np.testing.assert_array_equal(np.asarray(restored.step),
+                                  np.asarray(host_state.step))
+    a = jax.tree_util.tree_leaves(restored.g_params)
+    b = jax.tree_util.tree_leaves(host_state.g_params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # optimizer state round-trips too (deliberate improvement over the
+    # reference, which resets Adam moments — SURVEY.md §7.4)
+    a = jax.tree_util.tree_leaves(restored.d_opt)
+    b = jax.tree_util.tree_leaves(host_state.d_opt)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_gradients_identical_across_mesh_sizes():
+    """DP invariance: same global batch on 1-device vs 8-device mesh gives
+    the same updated params (XLA psum == single-device mean)."""
+    cfg = micro_cfg(batch=8)
+    imgs = np.random.RandomState(0).randint(
+        0, 255, (8, 16, 16, 3), dtype=np.uint8)
+    rng = jax.random.PRNGKey(3)
+    results = []
+    for devs in (jax.devices()[:1], jax.devices()[:8]):
+        env = make_mesh(cfg.mesh, devices=devs)
+        state = create_train_state(cfg, jax.random.PRNGKey(0))
+        state = jax.device_put(state, env.replicated())
+        fns = make_train_steps(cfg, env, batch_size=8)
+        sharded = jax.device_put(imgs, env.batch())
+        state, _ = fns.d_step(state, sharded, rng)
+        results.append(jax.device_get(state.d_params))
+    a = jax.tree_util.tree_leaves(results[0])
+    b = jax.tree_util.tree_leaves(results[1])
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-4, atol=2e-5)
