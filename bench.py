@@ -6,25 +6,37 @@ Baseline: ≥200 img/sec/chip on TPU v4 (BASELINE.json:5).
 Measures the steady-state hot loop (D step + G step, with the lazy-reg
 variants mixed in at their real cadence) on synthetic data, excluding
 compilation, on however many chips are visible.
+
+Hardened against backend-init failure: the outer process runs the actual
+benchmark in a child, first with the ambient environment (the real TPU
+path), then — if that fails or hangs — with a sanitized CPU environment
+(PYTHONPATH cleared so the container's TPU-tunnel sitecustomize cannot
+claim/hang the backend).  The outer process ALWAYS emits exactly one JSON
+line, with an "error" field if every attempt failed.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 200.0
+_INNER_FLAG = "_GRAFT_BENCH_INNER"
+_SELF = os.path.abspath(__file__)
 
 
-def main() -> None:
+def _run_inner() -> None:
+    """The actual benchmark. Prints the one JSON line on success; any
+    exception exits nonzero and the outer process falls back."""
+    import dataclasses
+
     import jax
     import numpy as np
 
     from gansformer_tpu.core.config import get_preset
-    import dataclasses
-
     from gansformer_tpu.parallel.mesh import make_mesh
     from gansformer_tpu.train.state import create_train_state
     from gansformer_tpu.train.steps import make_train_steps
@@ -83,7 +95,78 @@ def main() -> None:
                   if on_tpu else "train_img_per_sec_per_chip_cpu_proxy",
         "value": round(img_per_sec_per_chip, 2),
         "unit": "img/sec/chip",
-        "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+        "vs_baseline": round(
+            img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+    }))
+
+
+def _probe_tpu(timeout: float = 90.0) -> bool:
+    """Cheap child that just initializes the ambient backend. Returns True
+    iff a TPU platform comes up within the timeout (a wedged tunnel claim
+    hangs forever — don't let the full bench budget pay for that)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            env=dict(os.environ), capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "tpu" in (proc.stdout or "")
+
+
+def _attempt(env: dict, timeout: float):
+    """Run the inner bench in a child; return parsed JSON dict or None."""
+    env = dict(env)
+    env[_INNER_FLAG] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, _SELF], env=env,
+            cwd=os.path.dirname(_SELF),
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    if proc.returncode != 0:
+        return None, (proc.stderr or "")[-2000:]
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"no JSON line in output: {(proc.stdout or '')[-500:]!r}"
+
+
+def main() -> None:
+    if os.environ.get(_INNER_FLAG) == "1":
+        _run_inner()
+        return
+
+    sys.path.insert(0, os.path.dirname(_SELF))
+    from gansformer_tpu.utils.hostenv import sanitized_cpu_env
+
+    attempts = []
+    if _probe_tpu():
+        # ambient env: the real TPU path (axon plugin); generous budget
+        # for first-compile of all four step variants.
+        attempts.append((dict(os.environ), 420.0))
+    # sanitized CPU: PYTHONPATH cleared so the TPU sitecustomize can't
+    # claim/hang the tunnel; proxy config keeps runtime small.
+    attempts.append((sanitized_cpu_env(1), 270.0))
+    last_err = None
+    for env, timeout in attempts:
+        result, err = _attempt(env, timeout)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        last_err = err
+    print(json.dumps({
+        "metric": "train_img_per_sec_per_chip_ffhq256_duplex",
+        "value": 0.0,
+        "unit": "img/sec/chip",
+        "vs_baseline": 0.0,
+        "error": (last_err or "all attempts failed")[:1500],
     }))
 
 

@@ -1,0 +1,219 @@
+"""Convert public Inception-v3 checkpoints to the FID extractor's npz layout.
+
+The reference's FID rests on NVIDIA's pickled TF1 Inception graph
+(``src/metrics/frechet_inception_distance.py``; SURVEY.md §3.3).  This
+framework loads weights from a flat ``{'path/to/param': array}`` npz
+(``inception.load_params_npz``); this module produces that npz from either
+of the two practical public sources:
+
+* **Keras** — ``keras.applications.InceptionV3`` (same TF-slim architecture;
+  ``weights='imagenet'`` when network access exists, or an already-downloaded
+  ``.h5``).  Keras names layers positionally (``conv2d_42``), so pairing is
+  by topological order: keras creates Conv2D/BatchNormalization layers in
+  exactly the source-code order our Flax modules are called in; the golden
+  test (``tests/test_inception_convert.py``) locks this pairing down by
+  asserting forward parity against keras itself.
+* **Torch** — a ``state_dict`` in torchvision naming (this covers
+  pytorch-fid's ``pt_inception-2015-12-05`` export of the original TF1 FID
+  graph, the checkpoint that makes FID numbers comparable to published
+  values).  Mapping is structural (``Mixed_5b.branch1x1.conv.weight`` →
+  ``Mixed_5b/b1x1/conv/kernel``), with OIHW→HWIO transposes.
+
+Name-mapping summary (torch → ours):
+  ``Conv2d_{1a_3x3,2a_3x3,2b_3x3,3b_1x1,4a_3x3}`` → ``Conv2d_{1a,2a,2b,3b,4a}``
+  ``branch1x1`` → ``b1x1``; ``branch5x5_N`` → ``b5x5_N``;
+  ``branch3x3dbl_N[ab]`` → ``b3x3dbl_N[ab]``; ``branch3x3[_N]`` → ``b3x3[_N]``;
+  ``branch7x7_N`` → ``b7x7_N``; ``branch7x7dbl_N`` → ``b7x7dbl_N``;
+  ``branch7x7x3_N`` → ``b7x7x3_N``; ``branch_pool`` → ``bpool``; ``fc`` → ``fc``
+  per-conv: ``conv.weight``→``conv/kernel`` (HWIO), ``bn.bias``→``beta``,
+  ``bn.running_mean``→``mean``, ``bn.running_var``→``var``.
+
+CLI:
+  python -m gansformer_tpu.metrics.convert_inception --keras imagenet -o w.npz
+  python -m gansformer_tpu.metrics.convert_inception --keras path.h5 -o w.npz
+  python -m gansformer_tpu.metrics.convert_inception --torch path.pt -o w.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List
+
+import numpy as np
+
+
+def ordered_convbn_paths() -> List[str]:
+    """Our ConvBN module paths in call (= keras creation) order."""
+    mixed_a = ["b1x1", "b5x5_1", "b5x5_2",
+               "b3x3dbl_1", "b3x3dbl_2", "b3x3dbl_3", "bpool"]
+    mixed_b = ["b3x3", "b3x3dbl_1", "b3x3dbl_2", "b3x3dbl_3"]
+    mixed_c = ["b1x1", "b7x7_1", "b7x7_2", "b7x7_3",
+               "b7x7dbl_1", "b7x7dbl_2", "b7x7dbl_3", "b7x7dbl_4",
+               "b7x7dbl_5", "bpool"]
+    mixed_d = ["b3x3_1", "b3x3_2",
+               "b7x7x3_1", "b7x7x3_2", "b7x7x3_3", "b7x7x3_4"]
+    mixed_e = ["b1x1", "b3x3_1", "b3x3_2a", "b3x3_2b",
+               "b3x3dbl_1", "b3x3dbl_2", "b3x3dbl_3a", "b3x3dbl_3b", "bpool"]
+    paths = [f"Conv2d_{n}" for n in ("1a", "2a", "2b", "3b", "4a")]
+    for block, branches in [
+        ("Mixed_5b", mixed_a), ("Mixed_5c", mixed_a), ("Mixed_5d", mixed_a),
+        ("Mixed_6a", mixed_b),
+        ("Mixed_6b", mixed_c), ("Mixed_6c", mixed_c),
+        ("Mixed_6d", mixed_c), ("Mixed_6e", mixed_c),
+        ("Mixed_7a", mixed_d),
+        ("Mixed_7b", mixed_e), ("Mixed_7c", mixed_e),
+    ]:
+        paths += [f"{block}/{b}" for b in branches]
+    return paths
+
+
+def from_keras(model) -> Dict[str, np.ndarray]:
+    """Keras InceptionV3 (include_top=True) → flat param dict."""
+    import keras
+
+    def _creation_index(layer) -> int:
+        # keras auto-names ('conv2d_42') carry creation order; model.layers
+        # itself is DEPTH-sorted (branches interleave), so sort it back.
+        suffix = layer.name.rsplit("_", 1)[-1]
+        return int(suffix) if suffix.isdigit() else 0
+
+    convs = sorted((l for l in model.layers
+                    if isinstance(l, keras.layers.Conv2D)),
+                   key=_creation_index)
+    bns = sorted((l for l in model.layers
+                  if isinstance(l, keras.layers.BatchNormalization)),
+                 key=_creation_index)
+    dense = [l for l in model.layers if isinstance(l, keras.layers.Dense)]
+    paths = ordered_convbn_paths()
+    if not (len(convs) == len(bns) == len(paths)):
+        raise ValueError(
+            f"layer count mismatch: {len(convs)} convs, {len(bns)} BNs, "
+            f"expected {len(paths)} — keras architecture drifted?")
+    flat: Dict[str, np.ndarray] = {}
+    for path, conv, bn in zip(paths, convs, bns):
+        (kernel,) = conv.get_weights()          # HWIO already
+        beta, mean, var = bn.get_weights()      # scale=False in InceptionV3
+        flat[f"{path}/conv/kernel"] = np.asarray(kernel, np.float32)
+        flat[f"{path}/beta"] = np.asarray(beta, np.float32)
+        flat[f"{path}/mean"] = np.asarray(mean, np.float32)
+        flat[f"{path}/var"] = np.asarray(var, np.float32)
+    if len(dense) != 1:
+        raise ValueError(f"expected 1 Dense head, found {len(dense)}")
+    kernel, bias = dense[0].get_weights()
+    flat["fc/kernel"] = np.asarray(kernel, np.float32)
+    flat["fc/bias"] = np.asarray(bias, np.float32)
+    return flat
+
+
+_TORCH_CONV_RENAME = {
+    "Conv2d_1a_3x3": "Conv2d_1a", "Conv2d_2a_3x3": "Conv2d_2a",
+    "Conv2d_2b_3x3": "Conv2d_2b", "Conv2d_3b_1x1": "Conv2d_3b",
+    "Conv2d_4a_3x3": "Conv2d_4a",
+}
+
+
+def _torch_path(module: str) -> str:
+    """torchvision module path → our module path."""
+    if module in _TORCH_CONV_RENAME:
+        return _TORCH_CONV_RENAME[module]
+    block, _, branch = module.partition(".")
+    if not branch:
+        raise KeyError(module)
+    ours = ("bpool" if branch == "branch_pool"
+            else branch.replace("branch", "b"))
+    return f"{block}/{ours}"
+
+
+def from_torch_state_dict(sd) -> Dict[str, np.ndarray]:
+    """torchvision-named state_dict → flat param dict (OIHW→HWIO).
+
+    torchvision's BasicConv2d uses affine BN (a per-channel scale γ our
+    scale-free ConvBN lacks); since both use eps=1e-3 the fold is exact:
+    γ·(conv(x)−μ)·rsqrt(σ²+eps)+β == ((γ·k)∗x − γμ)·rsqrt(σ²+eps)+β,
+    i.e. scale the conv kernel's output channels and μ by γ.
+    """
+    flat: Dict[str, np.ndarray] = {}
+    gammas: Dict[str, np.ndarray] = {}
+    for key, value in sd.items():
+        v = np.asarray(getattr(value, "numpy", lambda: value)(),
+                       dtype=np.float32)
+        if key.startswith("AuxLogits") or key.endswith("num_batches_tracked"):
+            continue
+        if key == "fc.weight":
+            flat["fc/kernel"] = v.T
+            continue
+        if key == "fc.bias":
+            flat["fc/bias"] = v
+            continue
+        module, leaf2, leaf1 = key.rsplit(".", 2)[0], *key.rsplit(".", 2)[1:]
+        path = _torch_path(module)
+        if leaf2 == "conv" and leaf1 == "weight":
+            flat[f"{path}/conv/kernel"] = v.transpose(2, 3, 1, 0)
+        elif leaf2 == "bn" and leaf1 == "bias":
+            flat[f"{path}/beta"] = v
+        elif leaf2 == "bn" and leaf1 == "running_mean":
+            flat[f"{path}/mean"] = v
+        elif leaf2 == "bn" and leaf1 == "running_var":
+            flat[f"{path}/var"] = v
+        elif leaf2 == "bn" and leaf1 == "weight":
+            gammas[path] = v
+        else:
+            raise KeyError(f"unrecognized state_dict entry {key!r}")
+    for path, gamma in gammas.items():
+        flat[f"{path}/conv/kernel"] = flat[f"{path}/conv/kernel"] * gamma
+        flat[f"{path}/mean"] = flat[f"{path}/mean"] * gamma
+    return flat
+
+
+def expected_keys() -> List[str]:
+    keys = []
+    for p in ordered_convbn_paths():
+        keys += [f"{p}/conv/kernel", f"{p}/beta", f"{p}/mean", f"{p}/var"]
+    return keys + ["fc/kernel", "fc/bias"]
+
+
+def validate(flat: Dict[str, np.ndarray]) -> None:
+    missing = sorted(set(expected_keys()) - set(flat))
+    extra = sorted(set(flat) - set(expected_keys()))
+    if missing or extra:
+        raise ValueError(f"bad conversion: missing={missing[:5]}... "
+                         f"extra={extra[:5]}...")
+
+
+def save_npz(flat: Dict[str, np.ndarray], path: str) -> None:
+    validate(flat)
+    np.savez(path, **flat)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--keras", metavar="H5_OR_IMAGENET",
+                     help="'imagenet' (downloads) or a keras .h5 weights file")
+    src.add_argument("--torch", metavar="PT",
+                     help="torch state_dict file in torchvision naming")
+    ap.add_argument("-o", "--output", required=True, help="output .npz")
+    args = ap.parse_args(argv)
+
+    if args.keras:
+        import keras
+
+        weights = args.keras if args.keras == "imagenet" else None
+        model = keras.applications.InceptionV3(
+            weights=weights, classifier_activation=None)
+        if weights is None:
+            model.load_weights(args.keras)
+        flat = from_keras(model)
+    else:
+        import torch
+
+        obj = torch.load(args.torch, map_location="cpu",
+                         weights_only=False)
+        sd = obj.state_dict() if hasattr(obj, "state_dict") else obj
+        flat = from_torch_state_dict(sd)
+    save_npz(flat, args.output)
+    print(f"wrote {len(flat)} arrays → {args.output}")
+
+
+if __name__ == "__main__":
+    main()

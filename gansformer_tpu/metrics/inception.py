@@ -27,6 +27,15 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _avg_pool_tf(x):
+    """3×3/1 SAME average pool with TF semantics: padded positions are
+    EXCLUDED from the divisor (``count_include_pad=False``).  The reference's
+    TF1 Inception graph — and pytorch-fid's patched torchvision port — both
+    use this; including the padding shifts border features and breaks FID
+    comparability."""
+    return nn.avg_pool(x, (3, 3), (1, 1), "SAME", count_include_pad=False)
+
+
 class ConvBN(nn.Module):
     features: int
     kernel: Tuple[int, int]
@@ -56,7 +65,7 @@ class InceptionA(nn.Module):
         b3 = ConvBN(64, (1, 1), name="b3x3dbl_1")(x)
         b3 = ConvBN(96, (3, 3), name="b3x3dbl_2")(b3)
         b3 = ConvBN(96, (3, 3), name="b3x3dbl_3")(b3)
-        bp = nn.avg_pool(x, (3, 3), (1, 1), "SAME")
+        bp = _avg_pool_tf(x)
         bp = ConvBN(self.pool_features, (1, 1), name="bpool")(bp)
         return jnp.concatenate([b1, b5, b3, bp], axis=-1)
 
@@ -87,7 +96,7 @@ class InceptionC(nn.Module):
         bd = ConvBN(c7, (1, 7), name="b7x7dbl_3")(bd)
         bd = ConvBN(c7, (7, 1), name="b7x7dbl_4")(bd)
         bd = ConvBN(192, (1, 7), name="b7x7dbl_5")(bd)
-        bp = nn.avg_pool(x, (3, 3), (1, 1), "SAME")
+        bp = _avg_pool_tf(x)
         bp = ConvBN(192, (1, 1), name="bpool")(bp)
         return jnp.concatenate([b1, b7, bd, bp], axis=-1)
 
@@ -116,7 +125,7 @@ class InceptionE(nn.Module):
         bd = ConvBN(384, (3, 3), name="b3x3dbl_2")(bd)
         bd = jnp.concatenate([ConvBN(384, (1, 3), name="b3x3dbl_3a")(bd),
                               ConvBN(384, (3, 1), name="b3x3dbl_3b")(bd)], axis=-1)
-        bp = nn.avg_pool(x, (3, 3), (1, 1), "SAME")
+        bp = _avg_pool_tf(x)
         bp = ConvBN(192, (1, 1), name="bpool")(bp)
         return jnp.concatenate([b1, b3, bd, bp], axis=-1)
 
@@ -167,12 +176,16 @@ class FeatureExtractor:
     """Jitted (features, logits) on [-1,1] images; batched sweep helper."""
 
     def __init__(self, params: Optional[Any] = None, seed: int = 0):
-        self.net = InceptionV3()
         if params is None:
+            self.net = InceptionV3()
             params = self.net.init(
                 jax.random.PRNGKey(seed), jnp.zeros((1, 299, 299, 3)))["params"]
             self.calibrated = False
         else:
+            # class count follows the checkpoint: 1008 for the reference's
+            # TF1 graph, 1000 for torchvision/keras ImageNet weights.
+            num_classes = int(np.shape(params["fc"]["kernel"])[-1])
+            self.net = InceptionV3(num_classes=num_classes)
             self.calibrated = True
         self.params = params
         self._apply = jax.jit(
@@ -197,9 +210,8 @@ class FeatureExtractor:
         return np.concatenate(feats), np.concatenate(logits)
 
 
-def load_params_npz(path: str):
-    """Load a flat {'a/b/c': array} npz into the nested params dict."""
-    flat = dict(np.load(path))
+def tree_from_flat(flat) -> dict:
+    """{'a/b/c': array} → nested params dict."""
     tree: dict = {}
     for k, v in flat.items():
         node = tree
@@ -208,6 +220,11 @@ def load_params_npz(path: str):
             node = node.setdefault(p, {})
         node[parts[-1]] = jnp.asarray(v)
     return tree
+
+
+def load_params_npz(path: str):
+    """Load a flat {'a/b/c': array} npz into the nested params dict."""
+    return tree_from_flat(dict(np.load(path)))
 
 
 def make_extractor(weights_path: Optional[str] = None) -> FeatureExtractor:

@@ -91,7 +91,11 @@ class FIDMetric(Metric):
         feats, _ = _fake_features(sample_fn, extractor, self.num_images,
                                   self.batch_size)
         mu_f, s_f = compute_activation_stats(feats)
-        return {self.name: frechet_distance(mu_r, s_r, mu_f, s_f)}
+        # With random Inception weights the number is a valid two-sample
+        # discrepancy but NOT comparable to published FID — say so in the
+        # metric name itself so it can never be mistaken for the real thing.
+        name = self.name if extractor.calibrated else f"{self.name}_uncal"
+        return {name: frechet_distance(mu_r, s_r, mu_f, s_f)}
 
 
 class ISMetric(Metric):
@@ -106,7 +110,8 @@ class ISMetric(Metric):
         _, logits = _fake_features(sample_fn, extractor, self.num_images,
                                    self.batch_size)
         mean, std = inception_score(logits, self.splits)
-        return {f"{self.name}_mean": mean, f"{self.name}_std": std}
+        name = self.name if extractor.calibrated else f"{self.name}_uncal"
+        return {f"{name}_mean": mean, f"{name}_std": std}
 
 
 class MetricGroup:

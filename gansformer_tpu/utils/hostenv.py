@@ -1,0 +1,28 @@
+"""Host-environment sanitation for CPU-only JAX child processes.
+
+This build container injects a TPU PJRT plugin through a ``PYTHONPATH``
+sitecustomize that claims a single-session TPU tunnel at interpreter start
+and can hang every later interpreter — even under ``JAX_PLATFORMS=cpu``.
+Anything that needs a deterministic CPU (or virtual multi-device CPU)
+backend therefore re-execs in a child with this sanitized environment.
+Used by ``bench.py`` (CPU fallback) and ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def sanitized_cpu_env(n_devices: int = 1,
+                      extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """A copy of ``os.environ`` forced onto an n-device virtual CPU backend:
+    TPU-plugin sitecustomize dropped, platform pinned, host devices forced."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.pop("JAX_PLATFORM_NAME", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    if extra:
+        env.update(extra)
+    return env

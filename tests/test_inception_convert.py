@@ -1,0 +1,164 @@
+"""Golden tests for the Inception checkpoint converter.
+
+The FID north star (BASELINE.json:2) is only meaningful with calibrated
+Inception weights (VERDICT round 1, missing item #1).  These tests prove the
+converter + our Flax architecture reproduce a *published implementation*
+(keras.applications.InceptionV3 — the same TF-slim architecture family as
+the reference's pickled TF1 graph) numerically, using randomly-initialized
+weights so they run airgapped: any pairing/transpose/BN-role mistake in the
+converter produces order-1 errors, far outside the tolerance.
+"""
+
+import numpy as np
+import pytest
+
+from gansformer_tpu.metrics.convert_inception import (
+    expected_keys, from_keras, from_torch_state_dict, ordered_convbn_paths,
+    save_npz)
+from gansformer_tpu.metrics.inception import (
+    FeatureExtractor, load_params_npz, tree_from_flat)
+
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture(scope="module")
+def keras_model():
+    model = keras.applications.InceptionV3(
+        weights=None, classifier_activation=None)
+    # Randomize BN stats/offsets so a mean<->var<->beta role mix-up in the
+    # converter cannot hide behind the zeros/ones defaults.
+    rng = np.random.RandomState(0)
+    for layer in model.layers:
+        if isinstance(layer, keras.layers.BatchNormalization):
+            beta, mean, var = layer.get_weights()
+            layer.set_weights([
+                rng.randn(*beta.shape).astype(np.float32) * 0.1,
+                rng.randn(*mean.shape).astype(np.float32) * 0.1,
+                rng.rand(*var.shape).astype(np.float32) * 0.5 + 0.75,
+            ])
+    return model
+
+
+@pytest.fixture(scope="module")
+def flat(keras_model):
+    return from_keras(keras_model)
+
+
+def test_conversion_is_complete(flat):
+    assert set(flat) == set(expected_keys())
+
+
+def test_forward_parity_vs_keras(keras_model, flat):
+    """pool3 features and logits match keras on a fixed input."""
+    rng = np.random.RandomState(1)
+    x = (rng.rand(2, 299, 299, 3).astype(np.float32) * 2.0) - 1.0
+
+    ref_model = keras.Model(
+        keras_model.input,
+        [keras_model.get_layer("avg_pool").output, keras_model.output])
+    ref_pool, ref_logits = [np.asarray(t) for t in
+                            ref_model(x, training=False)]
+
+    ours = FeatureExtractor(tree_from_flat(flat))
+    assert ours.calibrated
+    pool, logits = ours(x)
+    np.testing.assert_allclose(np.asarray(pool), ref_pool,
+                               rtol=1e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(logits), ref_logits,
+                               rtol=1e-3, atol=5e-3)
+
+
+def test_npz_round_trip(flat, tmp_path):
+    path = str(tmp_path / "inception.npz")
+    save_npz(flat, path)
+    tree = load_params_npz(path)
+    ext = FeatureExtractor(tree)
+    assert ext.calibrated
+    flat_back = {}
+
+    def walk(node, prefix):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                walk(v, prefix + k + "/")
+            else:
+                flat_back[prefix + k] = np.asarray(v)
+
+    walk(tree, "")
+    assert set(flat_back) == set(flat)
+    for k in flat:
+        np.testing.assert_array_equal(flat_back[k], flat[k])
+
+
+def _torch_module_name(path: str) -> str:
+    """Our module path → torchvision module path (inverse of converter)."""
+    first, _, branch = path.partition("/")
+    if not branch:
+        return {"Conv2d_1a": "Conv2d_1a_3x3", "Conv2d_2a": "Conv2d_2a_3x3",
+                "Conv2d_2b": "Conv2d_2b_3x3", "Conv2d_3b": "Conv2d_3b_1x1",
+                "Conv2d_4a": "Conv2d_4a_3x3"}[first]
+    torch_branch = ("branch_pool" if branch == "bpool"
+                    else branch.replace("b", "branch", 1))
+    return f"{first}.{torch_branch}"
+
+
+def test_torch_layout_matches_keras_layout(flat):
+    """A torchvision-named state_dict built from the keras weights converts
+    to the identical flat dict (validates the structural name mapping and
+    the OIHW->HWIO transpose without needing torchvision).  Affine BN scale
+    gamma (torchvision's BasicConv2d) must fold exactly into kernel+mean."""
+    rng = np.random.RandomState(2)
+    sd, gammas = {}, {}
+    for path in ordered_convbn_paths():
+        mod = _torch_module_name(path)
+        gamma = (rng.rand(flat[f"{path}/beta"].shape[0]).astype(np.float32)
+                 * 0.5 + 0.75)
+        gammas[path] = gamma
+        sd[f"{mod}.conv.weight"] = flat[f"{path}/conv/kernel"].transpose(
+            3, 2, 0, 1)
+        sd[f"{mod}.bn.weight"] = gamma
+        sd[f"{mod}.bn.bias"] = flat[f"{path}/beta"]
+        sd[f"{mod}.bn.running_mean"] = flat[f"{path}/mean"]
+        sd[f"{mod}.bn.running_var"] = flat[f"{path}/var"]
+        sd[f"{mod}.bn.num_batches_tracked"] = np.zeros((), np.int64)
+    sd["fc.weight"] = flat["fc/kernel"].T
+    sd["fc.bias"] = flat["fc/bias"]
+    sd["AuxLogits.conv0.conv.weight"] = np.zeros((1,), np.float32)  # skipped
+
+    flat2 = from_torch_state_dict(sd)
+    assert set(flat2) == set(flat)
+    for path in ordered_convbn_paths():
+        g = gammas[path]
+        np.testing.assert_allclose(flat2[f"{path}/conv/kernel"],
+                                   flat[f"{path}/conv/kernel"] * g, rtol=1e-6)
+        np.testing.assert_allclose(flat2[f"{path}/mean"],
+                                   flat[f"{path}/mean"] * g, rtol=1e-6)
+        np.testing.assert_array_equal(flat2[f"{path}/var"],
+                                      flat[f"{path}/var"])
+        np.testing.assert_array_equal(flat2[f"{path}/beta"],
+                                      flat[f"{path}/beta"])
+    np.testing.assert_array_equal(flat2["fc/kernel"], flat["fc/kernel"])
+
+
+def test_uncalibrated_metric_renamed():
+    """Random-weight extractor must label its FID as _uncal."""
+    from gansformer_tpu.metrics.metric_base import FIDMetric
+
+    class FakeDataset:
+        num_images = 8
+
+        def cache_tag(self):
+            return "fake"
+
+        def batches(self, batch_size, seed=0):
+            rng = np.random.RandomState(seed)
+            while True:
+                yield {"image": rng.randint(
+                    0, 255, (batch_size, 32, 32, 3), np.uint8)}
+
+    ext = FeatureExtractor(None)
+    assert not ext.calibrated
+    rng = np.random.RandomState(0)
+    fakes = rng.rand(4, 32, 32, 3).astype(np.float32) * 2 - 1
+    out = FIDMetric(num_images=4, batch_size=4).run(
+        lambda n: fakes[:n], FakeDataset(), ext, cache_dir=None)
+    assert list(out) == ["fid4_uncal"]

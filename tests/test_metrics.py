@@ -87,6 +87,6 @@ def test_metric_group_on_tiny_extractor():
         return jnp.asarray(rs.rand(n, 32, 32, 3).astype(np.float32) * 2 - 1)
 
     out = group.run(sample_fn, ds)
-    assert np.isfinite(out["fid16"]) and out["fid16"] >= 0
-    assert out["is16_mean"] >= 1.0
+    assert np.isfinite(out["fid16_uncal"]) and out["fid16_uncal"] >= 0
+    assert out["is16_uncal_mean"] >= 1.0
     assert out["calibrated"] == 0.0
