@@ -24,7 +24,9 @@ from __future__ import annotations
 
 import glob
 import os
+import queue
 import struct
+import threading
 from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
@@ -243,7 +245,8 @@ class TFRecordDataset(Dataset):
     (SURVEY.md §3.4).  Only the max-resolution file is read (progressive
     growing is not part of the GANsformer configs)."""
 
-    def __init__(self, path: str, resolution: Optional[int] = None):
+    def __init__(self, path: str, resolution: Optional[int] = None,
+                 shuffle_buffer: int = 4096):
         files = sorted(glob.glob(os.path.join(path, "*.tfrecords")))
         if not files:
             raise FileNotFoundError(f"no .tfrecords under {path}")
@@ -252,6 +255,7 @@ class TFRecordDataset(Dataset):
             match = [f for f in files if f"-r{lod:02d}" in f]
             files = match or files
         self.file = files[-1]  # highest resolution
+        self.shuffle_buffer = shuffle_buffer
         first = _parse_example_image(next(_iter_tfrecord_raw(self.file)))
         self.resolution = first.shape[0]
         self.channels = first.shape[2]
@@ -262,22 +266,31 @@ class TFRecordDataset(Dataset):
             self.has_labels = True
             self.label_dim = self.labels.shape[1]
 
+    # Byte budget for the decoded shuffle window: `shuffle_buffer` counts
+    # images, so cap it by bytes too or a 1024² dataset would hold ~12.9 GB
+    # per host at the 4096-image default.
+    SHUFFLE_BYTES_BUDGET = 512 * 1024 * 1024
+
     def batches(self, batch_size, seed=0, shard=(0, 1)):
         rs = np.random.RandomState(seed)
         shard_id, num_shards = shard
+        # Reservoir-style shuffle window (the tf.data shuffle_buffer analog):
+        # fill to `shuffle_buffer` decoded images, shuffle, drain half, refill.
+        img_bytes = self.resolution * self.resolution * self.channels
+        byte_cap = max(1, self.SHUFFLE_BYTES_BUDGET // img_bytes)
+        cap = max(min(self.shuffle_buffer, byte_cap), batch_size * 2)
         buf: list = []
-        epoch = 0
         while True:
             for i, payload in enumerate(_iter_tfrecord_raw(self.file)):
                 if i % num_shards != shard_id:
                     continue  # per-host shard, no cross-host shuffle (§7.3.6)
                 buf.append((i, _parse_example_image(payload)))
-                if len(buf) >= max(batch_size * 8, 256):  # shuffle buffer
+                if len(buf) >= cap:
                     rs.shuffle(buf)
-                    while len(buf) > batch_size * 4:
+                    while len(buf) > cap // 2 and len(buf) >= batch_size:
                         take = [buf.pop() for _ in range(batch_size)]
                         yield self._emit(take)
-            epoch += 1
+            rs.shuffle(buf)  # epoch boundary: flush what's left
             while len(buf) >= batch_size:
                 take = [buf.pop() for _ in range(batch_size)]
                 yield self._emit(take)
@@ -328,6 +341,77 @@ class ImageFolderDataset(Dataset):
             yield {"image": np.stack([self._load(self.files[i]) for i in idx])}
 
 
+class PrefetchIterator:
+    """Background-thread prefetch with a bounded queue — overlaps host-side
+    decode/shuffle with device compute (the tf.data ``prefetch`` analog the
+    reference gets for free from its in-graph input pipeline).
+
+    Exceptions raised by the producer surface on the consumer's next
+    ``next()``; ``close()`` (also via context manager) stops the thread.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, iterator: Iterator[dict], depth: int = 2):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._finished = False
+        self._error: Optional[BaseException] = None
+
+        def _produce():
+            try:
+                for item in iterator:
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # noqa: BLE001 — reraised on consumer
+                self._error = e
+            finally:
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=_produce, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        if self._finished or self._stop.is_set():
+            raise StopIteration
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            self._finished = True
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        try:  # unblock a producer stuck on a full queue
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def make_dataset(cfg) -> Dataset:
     """cfg: DataConfig (core.config)."""
     if cfg.source == "synthetic":
@@ -335,7 +419,8 @@ def make_dataset(cfg) -> Dataset:
     if cfg.source == "npz":
         return NpzDataset(cfg.path)
     if cfg.source == "tfrecord":
-        return TFRecordDataset(cfg.path, resolution=cfg.resolution)
+        return TFRecordDataset(cfg.path, resolution=cfg.resolution,
+                               shuffle_buffer=cfg.shuffle_buffer)
     if cfg.source == "folder":
         return ImageFolderDataset(cfg.path, resolution=cfg.resolution)
     raise ValueError(f"unknown data source {cfg.source!r}")

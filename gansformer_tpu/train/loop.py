@@ -23,7 +23,7 @@ import jax
 import numpy as np
 
 from gansformer_tpu.core.config import ExperimentConfig
-from gansformer_tpu.data.dataset import make_dataset
+from gansformer_tpu.data.dataset import PrefetchIterator, make_dataset
 from gansformer_tpu.parallel.mesh import MeshEnv, local_batch_size, make_mesh
 from gansformer_tpu.train import checkpoint as ckpt
 from gansformer_tpu.train.state import TrainState, create_train_state, param_count
@@ -72,7 +72,7 @@ def train(cfg: ExperimentConfig, run_dir: str,
     # SURVEY.md §7.3 item 6).
     multihost = jax.process_count() > 1
     local_bs = local_batch_size(t.batch_size, env) if multihost else t.batch_size
-    batches = dataset.batches(local_bs, seed=t.seed + 1, shard=shard)
+    batch_iter = dataset.batches(local_bs, seed=t.seed + 1, shard=shard)
     batch_sharding = env.batch()
 
     def put_batch(host_imgs: np.ndarray) -> jax.Array:
@@ -128,61 +128,71 @@ def train(cfg: ExperimentConfig, run_dir: str,
     last_metrics = {}
     snapshot_images(state, cur_nimg / 1000)
 
-    while cur_nimg < total_kimg * 1000:
-        batch = next(batches)
-        imgs = put_batch(batch["image"])
-        step_rng = jax.random.fold_in(jax.random.PRNGKey(t.seed + 4), it)
+    # Host-side decode/shuffle runs in a background thread so the device
+    # never waits on input (cfg.data.prefetch = queue depth in batches).
+    # Constructed HERE, directly inside the try, so the producer thread can
+    # never leak if anything earlier raises.
+    batches = PrefetchIterator(batch_iter, depth=cfg.data.prefetch)
+    try:
+        while cur_nimg < total_kimg * 1000:
+            batch = next(batches)
+            imgs = put_batch(batch["image"])
+            step_rng = jax.random.fold_in(jax.random.PRNGKey(t.seed + 4), it)
 
-        d_fn = fns.d_step_r1 if (it % t.d_reg_interval == 0) else fns.d_step
-        state, d_aux = d_fn(state, imgs, jax.random.fold_in(step_rng, 0))
-        g_fn = fns.g_step_pl if (it % t.g_reg_interval == 0) else fns.g_step
-        state, g_aux = g_fn(state, jax.random.fold_in(step_rng, 1))
+            d_fn = fns.d_step_r1 if (it % t.d_reg_interval == 0) else fns.d_step
+            state, d_aux = d_fn(state, imgs, jax.random.fold_in(step_rng, 0))
+            g_fn = fns.g_step_pl if (it % t.g_reg_interval == 0) else fns.g_step
+            state, g_aux = g_fn(state, jax.random.fold_in(step_rng, 1))
 
-        it += 1
-        cur_nimg += t.batch_size
-        last_metrics = {**d_aux, **g_aux}
+            it += 1
+            cur_nimg += t.batch_size
+            last_metrics = {**d_aux, **g_aux}
 
-        # --- tick boundary (the ONLY host sync) -----------------------------
-        if cur_nimg >= tick_start_nimg + t.kimg_per_tick * 1000 or \
-                cur_nimg >= total_kimg * 1000:
-            jax.block_until_ready(state.step)
-            now = time.time()
-            sec_per_tick = now - tick_start_time
-            imgs_done = cur_nimg - tick_start_nimg
-            fetched = {k: float(jax.device_get(v))
-                       for k, v in last_metrics.items()}
-            stats = {
-                "Progress/tick": tick,
-                "Progress/kimg": cur_nimg / 1000,
-                "timing/sec_per_tick": sec_per_tick,
-                "timing/img_per_sec": imgs_done / max(sec_per_tick, 1e-9),
-                "timing/img_per_sec_per_chip":
-                    imgs_done / max(sec_per_tick, 1e-9) / n_chips,
-                **fetched,
-            }
-            log.log_tick(stats)
-            tick += 1
-            tick_start_nimg = cur_nimg
-            tick_start_time = time.time()
+            # --- tick boundary (the ONLY host sync) -------------------------
+            if cur_nimg >= tick_start_nimg + t.kimg_per_tick * 1000 or \
+                    cur_nimg >= total_kimg * 1000:
+                jax.block_until_ready(state.step)
+                now = time.time()
+                sec_per_tick = now - tick_start_time
+                imgs_done = cur_nimg - tick_start_nimg
+                fetched = {k: float(jax.device_get(v))
+                           for k, v in last_metrics.items()}
+                stats = {
+                    "Progress/tick": tick,
+                    "Progress/kimg": cur_nimg / 1000,
+                    "timing/sec_per_tick": sec_per_tick,
+                    "timing/img_per_sec": imgs_done / max(sec_per_tick, 1e-9),
+                    "timing/img_per_sec_per_chip":
+                        imgs_done / max(sec_per_tick, 1e-9) / n_chips,
+                    **fetched,
+                }
+                log.log_tick(stats)
+                tick += 1
+                tick_start_nimg = cur_nimg
+                tick_start_time = time.time()
 
-            if tick % t.image_snapshot_ticks == 0:
-                snapshot_images(state, cur_nimg / 1000)
-            if tick % t.snapshot_ticks == 0:
-                # Orbax save() runs a cross-host barrier internally — every
-                # process must call it (gating on process 0 would deadlock
-                # a multi-host run).
-                ckpt.save(ckpt_dir, state, cfg)
-                log.write(f"checkpoint @ {cur_nimg / 1000:.1f} kimg")
-            if t.metric_ticks > 0 and t.metrics and tick % t.metric_ticks == 0:
-                results = run_metrics(state)
-                for name, val in results.items():
-                    log.metric(name, val, cur_nimg / 1000)
-                log.write("metrics @ {:.1f} kimg: {}".format(
-                    cur_nimg / 1000,
-                    {k: round(v, 3) for k, v in results.items()}))
+                if tick % t.image_snapshot_ticks == 0:
+                    snapshot_images(state, cur_nimg / 1000)
+                if tick % t.snapshot_ticks == 0:
+                    # Orbax save() runs a cross-host barrier internally —
+                    # every process must call it (gating on process 0 would
+                    # deadlock a multi-host run).
+                    ckpt.save(ckpt_dir, state, cfg)
+                    log.write(f"checkpoint @ {cur_nimg / 1000:.1f} kimg")
+                if t.metric_ticks > 0 and t.metrics and \
+                        tick % t.metric_ticks == 0:
+                    results = run_metrics(state)
+                    for name, val in results.items():
+                        log.metric(name, val, cur_nimg / 1000)
+                    log.write("metrics @ {:.1f} kimg: {}".format(
+                        cur_nimg / 1000,
+                        {k: round(v, 3) for k, v in results.items()}))
+    finally:
+        batches.close()
 
-    # final snapshot + checkpoint
+    # final snapshot + checkpoint (skip a re-save of an already-saved step)
     snapshot_images(state, cur_nimg / 1000)
-    ckpt.save(ckpt_dir, state, cfg)
+    if ckpt.latest_step(ckpt_dir) != int(jax.device_get(state.step)):
+        ckpt.save(ckpt_dir, state, cfg)
     log.write(f"done: {cur_nimg / 1000:.1f} kimg")
     return state
