@@ -1,4 +1,4 @@
-"""gansformer_tpu — a TPU-native (JAX/XLA/Pallas) GANsformer framework.
+"""gansformer_tpu — a TPU-native (JAX/XLA) GANsformer framework.
 
 A from-scratch re-design of the capability surface of
 GiorgiaAuroraAdorni/gansformer-reproducibility-challenge (StyleGAN2-based
@@ -7,7 +7,7 @@ Generative Adversarial Transformers, TF1/CUDA lineage) for TPU hardware:
 - ``ops``      — the compute primitives that replace the reference's custom
                  CUDA kernels (upfirdn2d, fused_bias_act, modulated conv,
                  bipartite attention), expressed as XLA-fusable jnp/lax
-                 composites with optional Pallas TPU kernels.
+                 composites XLA fuses on its own (profiling showed no need for hand-written kernels).
 - ``models``   — Flax generator (mapping + attention-augmented synthesis) and
                  discriminator.
 - ``losses``   — non-saturating logistic GAN loss, R1, path-length reg.

@@ -49,14 +49,24 @@ class ModelConfig:
     fmap_min: int = 1
     # 'none' | 'simplex' | 'duplex'  (SURVEY.md §2.3)
     attention: str = "duplex"
-    # Bipartite attention is applied at block resolutions 4..attn_max_res
-    # (cost is O(n*k), n = H*W — linear in pixels, the GANsformer scaling
-    # property to preserve; SURVEY.md §5 "Long-context").
-    attn_start_res: int = 8
+    # Bipartite attention is applied at block resolutions
+    # attn_start_res..attn_max_res (cost is O(n*k), n = H*W — linear in
+    # pixels, the GANsformer scaling property to preserve; SURVEY.md §5
+    # "Long-context").  Default 4: the reference attends "from 4x4 up"
+    # (SURVEY.md §2.3) — at n=16 the block costs almost nothing.
+    attn_start_res: int = 4
     attn_max_res: int = 128
     num_heads: int = 1
     # 'add' | 'mul' | 'both' — how attention output updates the grid features.
     integration: str = "both"
+    # Where conv modulation styles come from (SURVEY.md §3.2 shows
+    # ``modulated_conv2d(x, w_attn)`` — style derived from attention output):
+    #   'global'    — every conv is styled by the global latent only; the k
+    #                 components act region-wise through attention gating.
+    #   'attention' — convs after an attention block are styled by the global
+    #                 latent PLUS a learned projection of the refined latents
+    #                 (the reference's attention-driven styling).
+    style_mode: str = "global"
     pos_encoding: str = "sinusoidal"  # 'sinusoidal' | 'learned' | 'none'
     # Duplex: latents first update themselves from the grid (k-means-like
     # centroid step), then the grid attends back.
@@ -230,7 +240,8 @@ PRESETS = {
     "ffhq256-duplex": _preset(
         "ffhq256-duplex",
         ModelConfig(resolution=256, components=16, attention="duplex",
-                    attn_max_res=128, dtype="bfloat16"),
+                    attn_max_res=128, dtype="bfloat16",
+                    style_mode="attention"),
         TrainConfig(batch_size=32, total_kimg=25000, r1_gamma=10.0),
         DataConfig(name="ffhq", resolution=256, source="tfrecord"),
     ),
@@ -238,7 +249,8 @@ PRESETS = {
     "bedroom256-duplex": _preset(
         "bedroom256-duplex",
         ModelConfig(resolution=256, components=16, attention="duplex",
-                    attn_max_res=128, dtype="bfloat16"),
+                    attn_max_res=128, dtype="bfloat16",
+                    style_mode="attention"),
         TrainConfig(batch_size=32, total_kimg=25000, r1_gamma=100.0),
         DataConfig(name="lsun-bedroom", resolution=256, source="tfrecord"),
     ),
@@ -246,7 +258,8 @@ PRESETS = {
     "cityscapes256-duplex": _preset(
         "cityscapes256-duplex",
         ModelConfig(resolution=256, components=32, attention="duplex",
-                    attn_max_res=128, dtype="bfloat16"),
+                    attn_max_res=128, dtype="bfloat16",
+                    style_mode="attention"),
         TrainConfig(batch_size=32, total_kimg=25000, r1_gamma=20.0),
         DataConfig(name="cityscapes", resolution=256, source="tfrecord"),
     ),
@@ -254,7 +267,8 @@ PRESETS = {
     "ffhq1024-duplex": _preset(
         "ffhq1024-duplex",
         ModelConfig(resolution=1024, components=16, attention="duplex",
-                    attn_max_res=128, dtype="bfloat16"),
+                    attn_max_res=128, dtype="bfloat16",
+                    style_mode="attention"),
         TrainConfig(batch_size=32, total_kimg=25000, r1_gamma=32.0),
         DataConfig(name="ffhq", resolution=1024, source="tfrecord"),
     ),

@@ -7,10 +7,15 @@ pairs with noise + fused lrelu, tRGB skip summation with FIR-upsampled
 accumulation — augmented with simplex/duplex bipartite attention between the
 k latent components and the grid at resolutions 4..attn_max_res.
 
-Style routing: the dedicated *global* latent component drives every conv's
-modulation (StyleGAN2-style global statistics); the k components inject
-region-wise structure through the attention blocks.  This is the same split
-of responsibilities the reference implements.
+Style routing (``cfg.style_mode``):
+  'global'    — the dedicated *global* latent component drives every conv's
+                modulation (StyleGAN2-style global statistics); the k
+                components inject region-wise structure through the
+                attention-block gating only.
+  'attention' — after each attention block the refined latents are projected
+                and added to the global style, so later convs are modulated
+                by attention output — the ``modulated_conv2d(x, w_attn)``
+                routing of SURVEY.md §3.2.
 """
 
 from __future__ import annotations
@@ -23,7 +28,7 @@ import jax.numpy as jnp
 
 from gansformer_tpu.core.config import ModelConfig
 from gansformer_tpu.models.attention import BipartiteAttention
-from gansformer_tpu.models.layers import ModulatedConv
+from gansformer_tpu.models.layers import EqualDense, ModulatedConv
 from gansformer_tpu.ops import upsample_2d
 
 
@@ -49,20 +54,25 @@ class SynthesisNetwork(nn.Module):
 
         attn_res = set(cfg.attn_resolutions())
         f = cfg.blur_filter
+        assert cfg.style_mode in ("global", "attention"), cfg.style_mode
 
         const = self.param("const", nn.initializers.normal(1.0),
                            (1, 4, 4, cfg.nf(4)), jnp.float32)
         x = jnp.broadcast_to(const, (n, 4, 4, cfg.nf(4))).astype(dtype)
 
+        # Running conv style: starts at the global latent; in 'attention'
+        # mode each attention block folds its refined latents in, so convs
+        # downstream are modulated by attention output (w_attn, §3.2).
+        w_style = w_global
         rgb: Optional[jax.Array] = None
         for res in cfg.block_resolutions:
             nf = cfg.nf(res)
             if res > 4:
                 x = ModulatedConv(nf, up=2, resample_filter=f, dtype=dtype,
-                                  name=f"b{res}_conv_up")(x, w_global,
+                                  name=f"b{res}_conv_up")(x, w_style,
                                                           noise_mode=noise_mode)
             x = ModulatedConv(nf, resample_filter=f, dtype=dtype,
-                              name=f"b{res}_conv")(x, w_global,
+                              name=f"b{res}_conv")(x, w_style,
                                                    noise_mode=noise_mode)
             if res in attn_res:
                 x, y = BipartiteAttention(
@@ -73,10 +83,20 @@ class SynthesisNetwork(nn.Module):
                     kmeans_iters=cfg.kmeans_iters,
                     pos_encoding=cfg.pos_encoding,
                     dtype=dtype, name=f"b{res}_attn")(x, y)
+                if cfg.style_mode == "attention":
+                    # ReZero-gated: scalar starts at 0 so styling begins
+                    # exactly global and training grows the attention term.
+                    w_attn = EqualDense(
+                        cfg.w_dim, dtype=jnp.float32,
+                        name=f"b{res}_wattn")(
+                            y.mean(axis=1).astype(jnp.float32))
+                    gate = self.param(f"b{res}_wattn_gate",
+                                      nn.initializers.zeros, (), jnp.float32)
+                    w_style = w_global + gate * w_attn
             # tRGB skip: modulated 1×1, no demod, linear act.
             t = ModulatedConv(cfg.img_channels, kernel=1, demodulate=False,
                               use_noise=False, act="linear", dtype=dtype,
-                              name=f"b{res}_trgb")(x, w_global,
+                              name=f"b{res}_trgb")(x, w_style,
                                                    noise_mode="none")
             rgb = t if rgb is None else upsample_2d(rgb, f) + t
 
