@@ -39,21 +39,25 @@ def main(argv=None) -> None:
 
     # --num-images overrides the sample count *at construction* so the
     # metric name (and the metric-<name>.txt it lands in) stays honest.
+    from gansformer_tpu.parallel.mesh import make_mesh
+
+    env = make_mesh(cfg.mesh)  # FID sweep runs data-parallel over the mesh
     metrics = parse_metric_names(args.metrics, batch_size=args.batch_size,
                                  num_images=args.num_images)
-    group = MetricGroup(metrics, make_extractor(args.inception_npz),
+    group = MetricGroup(metrics, make_extractor(args.inception_npz, env=env),
                         cache_dir=args.cache_dir or
                         os.path.join(args.run_dir, "metric-cache"))
 
-    rng_holder = [jax.random.PRNGKey(7)]
+    # replicate params over the mesh; make_metric_samplers shards z/labels
+    # so the generator half of the sweep is data-parallel too
+    from gansformer_tpu.train.steps import make_metric_samplers
 
-    def sample_fn(n):
-        rng_holder[0], k1, k2 = jax.random.split(rng_holder[0], 3)
-        z = jax.random.normal(k1, (n, cfg.model.num_ws, cfg.model.latent_dim))
-        return fns.sample(state.ema_params, state.w_avg, z, k2,
-                          truncation_psi=args.truncation_psi)
+    state = jax.device_put(state, env.replicated())
+    sample_fn, pair_fn = make_metric_samplers(
+        fns, state, cfg, env, dataset,
+        truncation_psi=args.truncation_psi, seed=7)
 
-    results = group.run(sample_fn, dataset)
+    results = group.run(sample_fn, dataset, pair_fn=pair_fn)
     kimg = int(jax.device_get(state.step)) / 1000
     for name, val in results.items():
         print(f"{name}: {val:.4f}")

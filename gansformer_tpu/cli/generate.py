@@ -34,6 +34,13 @@ def main(argv=None) -> None:
     state = ckpt.restore(os.path.join(args.run_dir, "checkpoints"), template)
     fns = make_train_steps(cfg, batch_size=args.batch_size)
 
+    dataset = None
+    if cfg.model.label_dim:
+        # Conditional model: draw labels from the training distribution.
+        from gansformer_tpu.data.dataset import make_dataset
+
+        dataset = make_dataset(cfg.data)
+
     out_dir = args.out or os.path.join(args.run_dir, "generated")
     os.makedirs(out_dir, exist_ok=True)
     rng = jax.random.PRNGKey(args.seed)
@@ -42,9 +49,11 @@ def main(argv=None) -> None:
         n = min(args.batch_size, args.images_num - i)
         z = jax.random.normal(jax.random.fold_in(rng, i),
                               (n, cfg.model.num_ws, cfg.model.latent_dim))
+        label = (dataset.random_labels(n, seed=args.seed + i)
+                 if dataset is not None else None)
         imgs = fns.sample(state.ema_params, state.w_avg, z,
                           jax.random.fold_in(rng, i + 1),
-                          truncation_psi=args.truncation_psi)
+                          truncation_psi=args.truncation_psi, label=label)
         all_imgs.append(np.asarray(jax.device_get(imgs)))
     imgs = np.concatenate(all_imgs)
 

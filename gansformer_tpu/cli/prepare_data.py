@@ -1,12 +1,17 @@
 """Dataset preparation CLI — the role of the reference's ``dataset_tool.py``
-+ ``prepare_data.py`` (SURVEY.md §3.4): convert an image folder (or a
-builtin synthetic source) into a packed training archive.
++ ``prepare_data.py`` (SURVEY.md §3.4): convert an image folder, a CIFAR-10
+extract, or the builtin synthetic source into a training archive.
 
-Output format is this framework's fast path (``.npz`` with uint8 NHWC
-``images``), not TFRecords — the TFRecord *reader* exists for datasets
-already prepared for the reference (data/dataset.py), so conversion is only
-needed for new datasets.  Downloads are out of scope in an airgapped image;
-point --source-dir at data you already have.
+Two output formats:
+* ``--to npz`` — this framework's fast path (uint8 NHWC ``images`` +
+  optional ``labels``);
+* ``--to tfrecord`` — the reference's multi-resolution layout
+  (``<name>-r{02..NN}.tfrecords`` + ``<name>-rNN.labels``), via
+  ``data/tfrecord_writer.py``; files carry valid masked-CRC framing so
+  they are readable by stock ``tf.data`` and the reference itself.
+
+Downloads are out of scope in an airgapped image; point ``--source-dir`` /
+``--cifar10-dir`` at data you already have.
 """
 
 from __future__ import annotations
@@ -17,35 +22,88 @@ import os
 import numpy as np
 
 
-def main(argv=None) -> None:
-    p = argparse.ArgumentParser(description="Prepare a training dataset")
-    p.add_argument("--source-dir", default=None,
-                   help="directory of images (recursively scanned)")
-    p.add_argument("--synthetic", action="store_true",
-                   help="generate the procedural smoke dataset instead")
-    p.add_argument("--out", required=True, help="output .npz path")
-    p.add_argument("--resolution", type=int, default=256)
-    p.add_argument("--max-images", type=int, default=None)
-    args = p.parse_args(argv)
-
+def _collect(args):
+    """Resolve the input source → (image iterator, count, labels|None)."""
     if args.synthetic:
         from gansformer_tpu.data.dataset import SyntheticDataset
 
         n = args.max_images or 10000
         ds = SyntheticDataset(resolution=args.resolution, num_images=n)
-        imgs = ds._make(np.arange(n))
-    elif args.source_dir:
+        idx = np.arange(n)
+        return (ds._make(idx[i:i + 64]) for i in range(0, n, 64)), n, None
+    if args.cifar10_dir:
+        from gansformer_tpu.data.tfrecord_writer import load_cifar10
+
+        images, labels = load_cifar10(args.cifar10_dir)
+        if args.resolution != 32:
+            raise SystemExit("CIFAR-10 is 32×32; pass --resolution 32")
+        if args.max_images:
+            images, labels = images[: args.max_images], labels[: args.max_images]
+        return (images[i:i + 64] for i in range(0, len(images), 64)), \
+            len(images), labels
+    if args.source_dir:
         from gansformer_tpu.data.dataset import ImageFolderDataset
 
         ds = ImageFolderDataset(args.source_dir, resolution=args.resolution)
         files = ds.files[: args.max_images] if args.max_images else ds.files
-        imgs = np.stack([ds._load(f) for f in files])
-    else:
-        p.error("need --source-dir or --synthetic")
 
-    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
-    np.savez_compressed(args.out, images=imgs)
-    print(f"{len(imgs)} images @ {args.resolution}² → {args.out}")
+        def chunks():
+            for i in range(0, len(files), 64):
+                yield np.stack([ds._load(f) for f in files[i:i + 64]])
+
+        return chunks(), len(files), None
+    return None, 0, None
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Prepare a training dataset")
+    p.add_argument("--source-dir", default=None,
+                   help="directory of images (recursively scanned)")
+    p.add_argument("--cifar10-dir", default=None,
+                   help="extracted cifar-10-batches-py directory")
+    p.add_argument("--synthetic", action="store_true",
+                   help="generate the procedural smoke dataset instead")
+    p.add_argument("--to", choices=("npz", "tfrecord"), default="npz",
+                   help="output format (tfrecord = reference layout)")
+    p.add_argument("--out", required=True,
+                   help=".npz path (--to npz) or output directory "
+                        "(--to tfrecord)")
+    p.add_argument("--name", default=None,
+                   help="dataset name for tfrecord filenames "
+                        "(default: basename of --out)")
+    p.add_argument("--resolution", type=int, default=256)
+    p.add_argument("--max-images", type=int, default=None)
+    p.add_argument("--max-lod-only", action="store_true",
+                   help="write only the full-resolution tfrecord file "
+                        "(skip the progressive pyramid)")
+    args = p.parse_args(argv)
+
+    chunks, count, labels = _collect(args)
+    if chunks is None:
+        p.error("need --source-dir, --cifar10-dir, or --synthetic")
+
+    if args.to == "npz":
+        imgs = np.concatenate(list(chunks))
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        if labels is not None:
+            np.savez_compressed(args.out, images=imgs, labels=labels)
+        else:
+            np.savez_compressed(args.out, images=imgs)
+        print(f"{len(imgs)} images @ {args.resolution}² → {args.out}")
+        return
+
+    from gansformer_tpu.data.tfrecord_writer import TFRecordExporter
+
+    name = args.name or os.path.basename(os.path.normpath(args.out))
+    with TFRecordExporter(args.out, name, args.resolution,
+                          all_lods=not args.max_lod_only) as ex:
+        for chunk in chunks:
+            for img in chunk:
+                ex.add_image(img)
+        if labels is not None:
+            ex.add_labels(labels)
+        n = ex.num_images
+    print(f"{n} images @ {args.resolution}² → {args.out}/{name}-r*.tfrecords")
 
 
 if __name__ == "__main__":

@@ -45,6 +45,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d-lr", type=float)
     p.add_argument("--r1-gamma", type=float)
     p.add_argument("--seed", type=int)
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans + per-tick finite checks")
     # data overrides
     p.add_argument("--data-path", default=None)
     p.add_argument("--data-source",
@@ -77,6 +79,8 @@ def config_from_args(args) -> ExperimentConfig:
     train = override(cfg.train, batch_size=args.batch_size,
                      total_kimg=args.total_kimg, g_lr=args.g_lr,
                      d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed)
+    if args.debug_nans:
+        train = dataclasses.replace(train, debug_nans=True)
     data = override(cfg.data, path=args.data_path, source=args.data_source,
                     resolution=args.resolution)
     if args.mirror_augment:

@@ -37,6 +37,11 @@ class ModelConfig:
     latent_dim: int = 512
     w_dim: int = 512
     use_global: bool = True
+    # Conditional generation (reference: optional ``.labels`` file next to
+    # the TFRecords, SURVEY.md §2.2 dataset reader row).  0 = unconditional.
+    # When >0: G embeds the label into every mapping input; D scores via a
+    # projection head (logit = ⟨features, embed(label)⟩).
+    label_dim: int = 0
 
     # --- mapping network ---------------------------------------------------
     mapping_layers: int = 8
@@ -153,6 +158,10 @@ class TrainConfig:
     metrics: str = ""
 
     seed: int = 0
+
+    # Debug switch (SURVEY.md §5 sanitizer row): enables jax_debug_nans +
+    # per-tick finite checks on the fetched loss scalars.
+    debug_nans: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

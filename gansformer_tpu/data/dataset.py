@@ -46,6 +46,16 @@ class Dataset:
                 shard: Tuple[int, int] = (0, 1)) -> Iterator[dict]:
         raise NotImplementedError
 
+    def random_labels(self, n: int, seed: int = 0) -> Optional[np.ndarray]:
+        """n labels drawn from the dataset's label distribution (reference
+        ``get_random_labels``) — for conditional sampling at eval/snapshot
+        time.  None for unconditional datasets."""
+        labels = getattr(self, "labels", None)
+        if labels is None:
+            return None
+        rs = np.random.RandomState(seed)
+        return labels[rs.randint(0, len(labels), size=n)]
+
     def cache_tag(self) -> str:
         """Stable identity for disk caches (e.g. FID real-stats) — must
         distinguish different datasets, not just different classes."""

@@ -173,9 +173,16 @@ def preprocess(images: jax.Array) -> jax.Array:
 
 
 class FeatureExtractor:
-    """Jitted (features, logits) on [-1,1] images; batched sweep helper."""
+    """Jitted (features, logits) on [-1,1] images; batched sweep helper.
 
-    def __init__(self, params: Optional[Any] = None, seed: int = 0):
+    Pass a ``MeshEnv`` to run the sweep data-parallel over the mesh
+    (VERDICT r2 item 4): params are replicated, each batch is sharded on
+    the ``data`` axis, and the 50k-image FID sweep scales with chips.
+    Batches that don't divide the mesh are zero-padded and trimmed.
+    """
+
+    def __init__(self, params: Optional[Any] = None, seed: int = 0,
+                 env: Optional[Any] = None):
         if params is None:
             self.net = InceptionV3()
             params = self.net.init(
@@ -187,11 +194,24 @@ class FeatureExtractor:
             num_classes = int(np.shape(params["fc"]["kernel"])[-1])
             self.net = InceptionV3(num_classes=num_classes)
             self.calibrated = True
+        self.env = env
+        if env is not None:
+            params = jax.device_put(params, env.replicated())
         self.params = params
         self._apply = jax.jit(
             lambda p, x: self.net.apply({"params": p}, preprocess(x)))
 
     def __call__(self, images: jax.Array):
+        if self.env is not None:
+            n, d = images.shape[0], self.env.data_size
+            pad = (-n) % d
+            if pad:
+                images = jnp.concatenate(
+                    [jnp.asarray(images),
+                     jnp.zeros((pad,) + images.shape[1:], images.dtype)])
+            images = jax.device_put(images, self.env.batch())
+            f, l = self._apply(self.params, images)
+            return (f[:n], l[:n]) if pad else (f, l)
         return self._apply(self.params, images)
 
     def sweep(self, image_batches, max_images: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -227,8 +247,10 @@ def load_params_npz(path: str):
     return tree_from_flat(dict(np.load(path)))
 
 
-def make_extractor(weights_path: Optional[str] = None) -> FeatureExtractor:
-    env_path = weights_path or os.environ.get("GANSFORMER_TPU_INCEPTION_NPZ")
-    if env_path and os.path.exists(env_path):
-        return FeatureExtractor(load_params_npz(env_path))
-    return FeatureExtractor(None)
+def make_extractor(weights_path: Optional[str] = None,
+                   env: Optional[Any] = None) -> FeatureExtractor:
+    """env: optional MeshEnv — shards the activation sweep over the mesh."""
+    npz_path = weights_path or os.environ.get("GANSFORMER_TPU_INCEPTION_NPZ")
+    if npz_path and os.path.exists(npz_path):
+        return FeatureExtractor(load_params_npz(npz_path), env=env)
+    return FeatureExtractor(None, env=env)

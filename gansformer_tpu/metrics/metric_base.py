@@ -22,8 +22,37 @@ class Metric:
     name: str = "metric"
 
     def run(self, sample_fn: Callable[[int], jax.Array], dataset: Dataset,
-            extractor: FeatureExtractor, cache_dir: Optional[str]) -> Dict[str, float]:
+            extractor: FeatureExtractor, cache_dir: Optional[str],
+            pair_fn: Optional[Callable] = None,
+            sweep_cache: Optional[Dict] = None) -> Dict[str, float]:
+        """pair_fn(n, t, seed, epsilon) → (img_a, img_b): the generator's
+        PPL probe (train/steps.py ``ppl_pairs``); None for callers that
+        only run image-level metrics.  sweep_cache: per-group memo dict so
+        fid/is/pr share one 50k-fake sweep."""
         raise NotImplementedError
+
+
+def _real_features(dataset: Dataset, extractor: FeatureExtractor,
+                   num_images: int, batch_size: int,
+                   cache: Optional[dict] = None) -> np.ndarray:
+    """The ONE real-image feature sweep (FID stats + P&R share it);
+    memoized per MetricGroup.run like the fake sweep."""
+    if cache is not None and ("real", num_images, batch_size) in cache:
+        return cache[("real", num_images, batch_size)]
+    feats = []
+    seen = 0
+    for batch in dataset.batches(batch_size, seed=123):
+        imgs = normalize_images(np.asarray(batch["image"], np.float32))
+        f, _ = extractor(imgs)
+        take = min(len(f), num_images - seen)
+        feats.append(np.asarray(f[:take]))
+        seen += take
+        if seen >= num_images:
+            break
+    out = np.concatenate(feats)
+    if cache is not None:
+        cache[("real", num_images, batch_size)] = out
+    return out
 
 
 def _real_stats(dataset: Dataset, extractor: FeatureExtractor,
@@ -41,24 +70,20 @@ def _real_stats(dataset: Dataset, extractor: FeatureExtractor,
         if os.path.exists(key):
             z = np.load(key)
             return z["mu"], z["sigma"]
-    feats = []
-    seen = 0
-    for batch in dataset.batches(batch_size, seed=123):
-        imgs = normalize_images(np.asarray(batch["image"], np.float32))
-        f, _ = extractor(imgs)
-        take = min(len(f), num_images - seen)
-        feats.append(np.asarray(f[:take]))
-        seen += take
-        if seen >= num_images:
-            break
-    mu, sigma = compute_activation_stats(np.concatenate(feats))
+    mu, sigma = compute_activation_stats(
+        _real_features(dataset, extractor, num_images, batch_size))
     if key:
         os.makedirs(cache_dir, exist_ok=True)
         np.savez(key, mu=mu, sigma=sigma)
     return mu, sigma
 
 
-def _fake_features(sample_fn, extractor, num_images: int, batch_size: int):
+def _fake_features(sample_fn, extractor, num_images: int, batch_size: int,
+                   cache: Optional[dict] = None):
+    """50k-fake generation + extraction; memoized per MetricGroup.run so
+    fid/is/pr in one group share a single sweep."""
+    if cache is not None and ("fake", num_images, batch_size) in cache:
+        return cache[("fake", num_images, batch_size)]
     feats, logits = [], []
     seen = 0
     while seen < num_images:
@@ -68,7 +93,10 @@ def _fake_features(sample_fn, extractor, num_images: int, batch_size: int):
         feats.append(np.asarray(f[:take]))
         logits.append(np.asarray(l[:take]))
         seen += take
-    return np.concatenate(feats), np.concatenate(logits)
+    out = (np.concatenate(feats), np.concatenate(logits))
+    if cache is not None:
+        cache[("fake", num_images, batch_size)] = out
+    return out
 
 
 def _count_tag(n: int) -> str:
@@ -83,13 +111,14 @@ class FIDMetric(Metric):
         self.num_images = num_images
         self.batch_size = batch_size
 
-    def run(self, sample_fn, dataset, extractor, cache_dir):
+    def run(self, sample_fn, dataset, extractor, cache_dir, pair_fn=None,
+            sweep_cache=None):
         mu_r, s_r = _real_stats(dataset, extractor,
                                 min(self.num_images,
                                     dataset.num_images or self.num_images),
                                 self.batch_size, cache_dir)
         feats, _ = _fake_features(sample_fn, extractor, self.num_images,
-                                  self.batch_size)
+                                  self.batch_size, cache=sweep_cache)
         mu_f, s_f = compute_activation_stats(feats)
         # With random Inception weights the number is a valid two-sample
         # discrepancy but NOT comparable to published FID — say so in the
@@ -106,12 +135,67 @@ class ISMetric(Metric):
         self.batch_size = batch_size
         self.splits = splits
 
-    def run(self, sample_fn, dataset, extractor, cache_dir):
+    def run(self, sample_fn, dataset, extractor, cache_dir, pair_fn=None,
+            sweep_cache=None):
         _, logits = _fake_features(sample_fn, extractor, self.num_images,
-                                   self.batch_size)
+                                   self.batch_size, cache=sweep_cache)
         mean, std = inception_score(logits, self.splits)
         name = self.name if extractor.calibrated else f"{self.name}_uncal"
         return {f"{name}_mean": mean, f"{name}_std": std}
+
+
+class PPLMetric(Metric):
+    """Perceptual path length (reference perceptual_path_length.py) over the
+    generator's w-space lerp probe — needs ``pair_fn`` (train/steps.py
+    ``ppl_pairs``)."""
+
+    def __init__(self, num_samples: int = 50000, batch_size: int = 32,
+                 epsilon: float = 1e-4):
+        self.name = f"ppl{_count_tag(num_samples)}_wfull"
+        self.num_samples = num_samples
+        self.batch_size = batch_size
+        self.epsilon = epsilon
+
+    def run(self, sample_fn, dataset, extractor, cache_dir, pair_fn=None,
+            sweep_cache=None):
+        if pair_fn is None:
+            raise ValueError(
+                "PPL needs the generator's pair probe; pass pair_fn "
+                "(train/steps.py ppl_pairs) into MetricGroup.run")
+        from gansformer_tpu.metrics.ppl import (
+            ppl_from_distances, sample_ppl_distances)
+
+        d = sample_ppl_distances(pair_fn, extractor, self.num_samples,
+                                 self.batch_size, self.epsilon)
+        name = self.name if extractor.calibrated else f"{self.name}_uncal"
+        return {name: ppl_from_distances(d)}
+
+
+class PRMetric(Metric):
+    """Improved precision & recall (reference precision_recall.py)."""
+
+    def __init__(self, num_images: int = 50000, batch_size: int = 32,
+                 k: int = 3):
+        self.name = f"pr{_count_tag(num_images)}_{k}"
+        self.num_images = num_images
+        self.batch_size = batch_size
+        self.k = k
+
+    def run(self, sample_fn, dataset, extractor, cache_dir, pair_fn=None,
+            sweep_cache=None):
+        from gansformer_tpu.metrics.precision_recall import precision_recall
+
+        # P&R needs raw real FEATURES (not μ/Σ) — shares the single
+        # real-image sweep helper; fakes come from the per-group cache.
+        n_real = min(self.num_images,
+                     dataset.num_images or self.num_images)
+        feats_r = _real_features(dataset, extractor, n_real, self.batch_size,
+                                 cache=sweep_cache)
+        feats_f, _ = _fake_features(sample_fn, extractor, self.num_images,
+                                    self.batch_size, cache=sweep_cache)
+        p, r = precision_recall(feats_r, feats_f, k=self.k)
+        name = self.name if extractor.calibrated else f"{self.name}_uncal"
+        return {f"{name}_precision": p, f"{name}_recall": r}
 
 
 class MetricGroup:
@@ -126,10 +210,14 @@ class MetricGroup:
         self.cache_dir = cache_dir
 
     def run(self, sample_fn: Callable[[int], jax.Array],
-            dataset: Dataset) -> Dict[str, float]:
+            dataset: Dataset,
+            pair_fn: Optional[Callable] = None) -> Dict[str, float]:
         out: Dict[str, float] = {}
+        sweep_cache: Dict = {}   # fid/is/pr share one 50k-fake sweep
         for m in self.metrics:
-            out.update(m.run(sample_fn, dataset, self.extractor, self.cache_dir))
+            out.update(m.run(sample_fn, dataset, self.extractor,
+                             self.cache_dir, pair_fn=pair_fn,
+                             sweep_cache=sweep_cache))
         out["calibrated"] = float(self.extractor.calibrated)
         return out
 
@@ -154,6 +242,10 @@ def parse_metric_names(names: str, num_images: Optional[int] = None,
             out.append(FIDMetric(num_images or parse_count(n[3:]), batch_size))
         elif n.startswith("is"):
             out.append(ISMetric(num_images or parse_count(n[2:]), batch_size))
+        elif n.startswith("ppl"):
+            out.append(PPLMetric(num_images or parse_count(n[3:]), batch_size))
+        elif n.startswith("pr"):
+            out.append(PRMetric(num_images or parse_count(n[2:]), batch_size))
         else:
             raise ValueError(f"unknown metric {n!r}")
     return out

@@ -1,0 +1,48 @@
+"""Perceptual Path Length — reference ``src/metrics/perceptual_path_length.py``
+(SURVEY.md §2.2 "optional metrics"): mean perceptual distance between images
+at w-space lerp positions t and t+ε, scaled by 1/ε², with the extreme tails
+filtered out.
+
+Deliberate deviation (recorded in SURVEY.md §7.4): the lineage measures
+image distance with a VGG16 LPIPS network downloaded from NVIDIA; this
+framework uses the Inception pool3 feature L2 of the shared FID extractor
+instead — one backbone for every metric, no second weight download, and
+the distance is still a deep perceptual metric.  Numbers are therefore not
+directly comparable to published PPL (which is fine: PPL is used as a
+*relative* smoothness diagnostic between checkpoints of the same run).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def ppl_from_distances(d: np.ndarray, lo_pct: float = 1.0,
+                       hi_pct: float = 99.0) -> float:
+    """Filtered mean (the lineage drops both 1% tails before averaging)."""
+    d = np.asarray(d, np.float64)
+    lo, hi = np.percentile(d, [lo_pct, hi_pct])
+    mask = (d >= lo) & (d <= hi)
+    return float(d[mask].mean()) if mask.any() else float(d.mean())
+
+
+def sample_ppl_distances(pair_fn, extractor, num_samples: int,
+                         batch_size: int, epsilon: float = 1e-4,
+                         seed: int = 0) -> np.ndarray:
+    """Drive the ``pair_fn(n, t, rng_seed, epsilon)`` probe (built over the
+    generator by train/steps.py ``ppl_pairs``) and return per-sample
+    ε-normalized squared feature distances."""
+    rs = np.random.RandomState(seed)
+    out = []
+    seen = 0
+    while seen < num_samples:
+        # always full batches (constant jit shapes; divisible by any mesh
+        # the caller shards over) — the surplus is trimmed at the end
+        t = rs.rand(batch_size).astype(np.float32)   # sampling='full'
+        img_a, img_b = pair_fn(batch_size, t, rs.randint(2**31), epsilon)
+        fa, _ = extractor(img_a)
+        fb, _ = extractor(img_b)
+        diff = np.asarray(fa, np.float64) - np.asarray(fb, np.float64)
+        out.append((diff ** 2).sum(axis=-1) / (epsilon ** 2))
+        seen += batch_size
+    return np.concatenate(out)[:num_samples]

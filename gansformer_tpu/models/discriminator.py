@@ -24,8 +24,9 @@ class Discriminator(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, img: jax.Array) -> jax.Array:
-        """img: [N, R, R, C] → logits [N, 1]."""
+    def __call__(self, img: jax.Array,
+                 label: "jax.Array | None" = None) -> jax.Array:
+        """img: [N, R, R, C] (+ label [N, label_dim]) → logits [N, 1]."""
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         f = cfg.blur_filter
@@ -71,5 +72,19 @@ class Discriminator(nn.Module):
         x = EqualConv(cfg.nf(4), act="lrelu", dtype=dtype, name="head_conv")(x)
         x = x.reshape(n, -1)
         x = EqualDense(cfg.nf(2), act="lrelu", dtype=dtype, name="head_fc")(x)
+        if cfg.label_dim > 0:
+            # Projection head: logit = ⟨features, embed(label)⟩ / √dim — the
+            # conditional-D scheme of the StyleGAN2 lineage.
+            if label is None:
+                raise ValueError("conditional discriminator needs a label")
+            cmap_dim = cfg.nf(2)
+            feat = EqualDense(cmap_dim, dtype=jnp.float32, name="head_out")(
+                x.astype(jnp.float32))
+            cmap = EqualDense(cmap_dim, name="label_embed")(
+                label.astype(jnp.float32))
+            cmap = cmap * jax.lax.rsqrt(
+                jnp.mean(jnp.square(cmap), axis=-1, keepdims=True) + 1e-8)
+            return jnp.sum(feat * cmap, axis=-1, keepdims=True) / \
+                jnp.sqrt(jnp.asarray(cmap_dim, jnp.float32))
         x = EqualDense(1, dtype=jnp.float32, name="head_out")(x.astype(jnp.float32))
         return x

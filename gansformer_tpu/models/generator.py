@@ -27,21 +27,24 @@ class Generator(nn.Module):
         cfg = self.cfg
         self.mapping = MappingNetwork(
             w_dim=cfg.w_dim, hidden_dim=cfg.mapping_dim,
-            num_layers=cfg.mapping_layers, lrmul=cfg.mapping_lrmul)
+            num_layers=cfg.mapping_layers, lrmul=cfg.mapping_lrmul,
+            label_dim=cfg.label_dim)
         self.synthesis = SynthesisNetwork(cfg)
 
     def __call__(self, z: jax.Array, noise_mode: str = "random",
                  truncation_psi: float = 1.0,
-                 w_avg: Optional[jax.Array] = None) -> jax.Array:
+                 w_avg: Optional[jax.Array] = None,
+                 label: Optional[jax.Array] = None) -> jax.Array:
         """z: [N, num_ws, latent_dim] → images [N, R, R, C]."""
-        ws = self.mapping(z)
+        ws = self.mapping(z, label)
         if truncation_psi != 1.0:
             assert w_avg is not None, "truncation needs the w_avg EMA"
             ws = w_avg[None, None, :] + truncation_psi * (ws - w_avg[None, None, :])
         return self.synthesis(ws, noise_mode=noise_mode)
 
-    def map(self, z: jax.Array) -> jax.Array:
-        return self.mapping(z)
+    def map(self, z: jax.Array,
+            label: Optional[jax.Array] = None) -> jax.Array:
+        return self.mapping(z, label)
 
     def synthesize(self, ws: jax.Array, noise_mode: str = "random") -> jax.Array:
         return self.synthesis(ws, noise_mode=noise_mode)

@@ -21,15 +21,31 @@ class MappingNetwork(nn.Module):
     hidden_dim: int = 512
     num_layers: int = 8
     lrmul: float = 0.01
+    # Conditional path (label_dim > 0): the label is embedded, pixel-normed,
+    # and concatenated onto every component's latent before the MLP — the
+    # lineage's conditional-mapping scheme (embed + concat, SURVEY.md §2.2).
+    label_dim: int = 0
 
     @nn.compact
-    def __call__(self, z: jax.Array) -> jax.Array:
-        """z: [N, num_ws, latent_dim] → w: [N, num_ws, w_dim] (fp32)."""
+    def __call__(self, z: jax.Array,
+                 label: "jax.Array | None" = None) -> jax.Array:
+        """z: [N, num_ws, latent_dim] (+ label [N, label_dim]) →
+        w: [N, num_ws, w_dim] (fp32)."""
         assert z.ndim == 3
         x = z.astype(jnp.float32)
         # per-component pixel norm
         x = x * jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True)
                               + 1e-8)
+        if self.label_dim > 0:
+            if label is None:
+                raise ValueError("conditional mapping needs a label")
+            y = EqualDense(x.shape[-1], name="label_embed")(
+                label.astype(jnp.float32))
+            y = y * jax.lax.rsqrt(
+                jnp.mean(jnp.square(y), axis=-1, keepdims=True) + 1e-8)
+            y = jnp.broadcast_to(y[:, None, :],
+                                 (x.shape[0], x.shape[1], y.shape[-1]))
+            x = jnp.concatenate([x, y], axis=-1)
         for i in range(self.num_layers - 1):
             x = EqualDense(self.hidden_dim, lrmul=self.lrmul, act="lrelu",
                            name=f"fc{i}")(x)

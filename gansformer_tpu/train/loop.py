@@ -27,9 +27,21 @@ from gansformer_tpu.data.dataset import PrefetchIterator, make_dataset
 from gansformer_tpu.parallel.mesh import MeshEnv, local_batch_size, make_mesh
 from gansformer_tpu.train import checkpoint as ckpt
 from gansformer_tpu.train.state import TrainState, create_train_state, param_count
-from gansformer_tpu.train.steps import make_train_steps
+from gansformer_tpu.train.steps import make_metric_samplers, make_train_steps
 from gansformer_tpu.utils.image import save_image_grid
 from gansformer_tpu.utils.logging import RunLogger
+
+
+def resolve_conditional(cfg: ExperimentConfig, dataset) -> ExperimentConfig:
+    """A labeled dataset flips G/D into conditional mode (VERDICT r2 item 7:
+    the label path is consumed end-to-end, not half-connected)."""
+    if dataset.has_labels and cfg.model.label_dim == 0:
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(
+                cfg.model, label_dim=dataset.label_dim))
+    return cfg
 
 
 def train(cfg: ExperimentConfig, run_dir: str,
@@ -41,12 +53,29 @@ def train(cfg: ExperimentConfig, run_dir: str,
     env = env or make_mesh(cfg.mesh)
     log = logger or RunLogger(run_dir)
     total_kimg = total_kimg if total_kimg is not None else t.total_kimg
+    if t.debug_nans:
+        from gansformer_tpu.utils.debug import enable_nan_debug
+
+        enable_nan_debug()
+        log.write("debug: jax_debug_nans ON (op-by-op NaN localization)")
+
+    # The dataset decides the conditional path: a labeled dataset switches
+    # G/D into conditional mode unless the config already pinned label_dim.
+    dataset = make_dataset(cfg.data)
+    cfg = resolve_conditional(cfg, dataset)
+    if jax.process_index() == 0:
+        # Re-record the *resolved* config so generate/evaluate rebuild the
+        # exact model that was trained (label_dim changes the param tree).
+        with open(os.path.join(run_dir, "config.json"), "w") as f:
+            f.write(cfg.to_json())
 
     n_chips = env.mesh.size
     log.write(f"mesh: {dict(zip(env.mesh.axis_names, env.mesh.devices.shape))} "
               f"({n_chips} devices, {jax.process_count()} processes)")
     log.write(f"config: {cfg.name}  resolution {cfg.model.resolution}  "
-              f"attention {cfg.model.attention}  k={cfg.model.components}")
+              f"attention {cfg.model.attention}  k={cfg.model.components}"
+              + (f"  label_dim={cfg.model.label_dim}"
+                 if cfg.model.label_dim else ""))
 
     # --- state ---------------------------------------------------------------
     rng = jax.random.PRNGKey(t.seed)
@@ -65,7 +94,6 @@ def train(cfg: ExperimentConfig, run_dir: str,
     fns = make_train_steps(cfg, env, batch_size=t.batch_size)
 
     # --- data ----------------------------------------------------------------
-    dataset = make_dataset(cfg.data)
     shard = (jax.process_index(), jax.process_count())
     # Each process produces only its share of the global batch; the global
     # array is assembled from process-local shards (no cross-host shuffle —
@@ -75,22 +103,24 @@ def train(cfg: ExperimentConfig, run_dir: str,
     batch_iter = dataset.batches(local_bs, seed=t.seed + 1, shard=shard)
     batch_sharding = env.batch()
 
-    def put_batch(host_imgs: np.ndarray) -> jax.Array:
+    def put_batch(host_arr: np.ndarray) -> jax.Array:
         if multihost:
             return jax.make_array_from_process_local_data(
-                batch_sharding, host_imgs)
-        return jax.device_put(host_imgs, batch_sharding)
+                batch_sharding, host_arr)
+        return jax.device_put(host_arr, batch_sharding)
 
     # --- fixed grid latents for snapshots ------------------------------------
     grid_n = min(16, t.batch_size * 2)
     grid_z = jax.random.normal(
         jax.random.PRNGKey(t.seed + 2),
         (grid_n, cfg.model.num_ws, cfg.model.latent_dim), np.float32)
+    grid_labels = (dataset.random_labels(grid_n, seed=t.seed + 2)
+                   if cfg.model.label_dim else None)
     noise_key = jax.random.PRNGKey(t.seed + 3)
 
     def snapshot_images(st: TrainState, kimg: float) -> None:
         imgs = fns.sample(st.ema_params, st.w_avg, grid_z, noise_key,
-                          truncation_psi=0.7)
+                          truncation_psi=0.7, label=grid_labels)
         save_image_grid(np.asarray(jax.device_get(imgs)),
                         os.path.join(run_dir, f"fakes{int(kimg):06d}.png"))
 
@@ -101,23 +131,18 @@ def train(cfg: ExperimentConfig, run_dir: str,
         (SURVEY.md §3.1 'periodic metric runs')."""
         nonlocal metric_group
         if metric_group is None:
+            from gansformer_tpu.metrics.inception import make_extractor
             from gansformer_tpu.metrics.metric_base import (
                 MetricGroup, parse_metric_names)
 
             metric_group = MetricGroup(
                 parse_metric_names(t.metrics, batch_size=t.batch_size),
+                extractor=make_extractor(env=env),  # sweep sharded over mesh
                 cache_dir=os.path.join(run_dir, "metric-cache"))
         group = metric_group
-        rng_holder = [jax.random.PRNGKey(t.seed + 5)]
-
-        def sample_fn(n):
-            rng_holder[0], k1, k2 = jax.random.split(rng_holder[0], 3)
-            z = jax.random.normal(
-                k1, (n, cfg.model.num_ws, cfg.model.latent_dim))
-            return fns.sample(st.ema_params, st.w_avg, z, k2,
-                              truncation_psi=1.0)
-
-        return group.run(sample_fn, dataset)
+        sample_fn, pair_fn = make_metric_samplers(
+            fns, st, cfg, env, dataset, truncation_psi=1.0, seed=t.seed + 5)
+        return group.run(sample_fn, dataset, pair_fn=pair_fn)
 
     # --- loop ----------------------------------------------------------------
     cur_nimg = int(jax.device_get(state.step))
@@ -137,12 +162,15 @@ def train(cfg: ExperimentConfig, run_dir: str,
         while cur_nimg < total_kimg * 1000:
             batch = next(batches)
             imgs = put_batch(batch["image"])
+            label = (put_batch(batch["label"])
+                     if cfg.model.label_dim and "label" in batch else None)
             step_rng = jax.random.fold_in(jax.random.PRNGKey(t.seed + 4), it)
 
             d_fn = fns.d_step_r1 if (it % t.d_reg_interval == 0) else fns.d_step
-            state, d_aux = d_fn(state, imgs, jax.random.fold_in(step_rng, 0))
+            state, d_aux = d_fn(state, imgs, jax.random.fold_in(step_rng, 0),
+                                label)
             g_fn = fns.g_step_pl if (it % t.g_reg_interval == 0) else fns.g_step
-            state, g_aux = g_fn(state, jax.random.fold_in(step_rng, 1))
+            state, g_aux = g_fn(state, jax.random.fold_in(step_rng, 1), label)
 
             it += 1
             cur_nimg += t.batch_size
@@ -157,6 +185,11 @@ def train(cfg: ExperimentConfig, run_dir: str,
                 imgs_done = cur_nimg - tick_start_nimg
                 fetched = {k: float(jax.device_get(v))
                            for k, v in last_metrics.items()}
+                if t.debug_nans:
+                    from gansformer_tpu.utils.debug import check_finite_stats
+
+                    check_finite_stats(
+                        fetched, where=f"kimg {cur_nimg / 1000:.1f}")
                 stats = {
                     "Progress/tick": tick,
                     "Progress/kimg": cur_nimg / 1000,

@@ -66,8 +66,9 @@ def create_train_state(cfg: ExperimentConfig, rng: jax.Array) -> TrainState:
     k_g, k_d, k_noise = jax.random.split(rng, 3)
     z = jnp.zeros((2, m.num_ws, m.latent_dim), jnp.float32)
     img = jnp.zeros((2, m.resolution, m.resolution, m.img_channels), jnp.float32)
-    g_vars = G.init({"params": k_g, "noise": k_noise}, z)
-    d_vars = D.init({"params": k_d}, img)
+    label = jnp.zeros((2, m.label_dim), jnp.float32) if m.label_dim else None
+    g_vars = G.init({"params": k_g, "noise": k_noise}, z, label=label)
+    d_vars = D.init({"params": k_d}, img, label)
     g_params, d_params = g_vars["params"], d_vars["params"]
     g_tx, d_tx = make_optimizers(cfg)
     return TrainState(

@@ -56,6 +56,10 @@ class TrainStepFns:
     # ``ema_params`` for eval (the Gs path) or ``g_params`` for debug grids.
     sample: Callable[..., jax.Array]
     sample_train: Callable[..., jax.Array]    # alias of ``sample``
+    # PPL probe (params, z0, z1, t, rng, epsilon) → (img_t, img_t+eps):
+    # images at w-space lerp positions t and t+ε with SHARED noise — the
+    # perceptual-path-length pair generator (metrics/ppl.py).
+    ppl_pairs: Callable[..., Tuple[jax.Array, jax.Array]]
 
 
 def _sample_z(cfg, rng, batch):
@@ -82,13 +86,14 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
                 ema_nimg, step.astype(jnp.float32) * t.ema_rampup)
         return 0.5 ** (batch / jnp.maximum(ema_nimg, 1e-8))
 
-    def g_forward(g_params, z, noise_rng, mix_rng=None):
+    def g_forward(g_params, z, noise_rng, mix_rng=None, label=None):
         """Mapping (+ style mixing) + synthesis; returns (imgs, ws)."""
-        ws = G.apply({"params": g_params}, z, method=Generator.map)
+        ws = G.apply({"params": g_params}, z, label, method=Generator.map)
         if mix_rng is not None and t.style_mixing_prob > 0:
             k_z, k_cut, k_p = jax.random.split(mix_rng, 3)
             z2 = jax.random.normal(k_z, z.shape, z.dtype)
-            ws2 = G.apply({"params": g_params}, z2, method=Generator.map)
+            ws2 = G.apply({"params": g_params}, z2, label,
+                          method=Generator.map)
             n, num_ws = ws.shape[0], ws.shape[1]
             # per-sample crossover component index; prob-gated
             cut = jax.random.randint(k_cut, (n, 1), 1, num_ws)
@@ -102,12 +107,14 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
 
     # ---------------- D steps ----------------
 
-    def d_loss_fn(d_params, g_params, reals, z, rng, do_r1: bool):
+    def d_loss_fn(d_params, g_params, reals, z, rng, label, do_r1: bool):
         k_noise, k_mix = jax.random.split(jax.random.fold_in(rng, 1))
-        fakes, _ = g_forward(g_params, z, k_noise, k_mix)
+        # Fakes are conditioned on the real batch's labels (the lineage
+        # samples G's training labels from the dataset distribution).
+        fakes, _ = g_forward(g_params, z, k_noise, k_mix, label)
         fakes = jax.lax.stop_gradient(fakes)
-        real_logits = D.apply({"params": d_params}, reals)
-        fake_logits = D.apply({"params": d_params}, fakes)
+        real_logits = D.apply({"params": d_params}, reals, label)
+        fake_logits = D.apply({"params": d_params}, fakes, label)
         loss = d_logistic_loss(real_logits, fake_logits)
         aux = {
             "Loss/D": loss,
@@ -115,14 +122,16 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
             "Loss/scores/fake": jnp.mean(fake_logits),
         }
         if do_r1:
-            r1 = r1_penalty(lambda x: D.apply({"params": d_params}, x), reals)
+            r1 = r1_penalty(
+                lambda x: D.apply({"params": d_params}, x, label), reals)
             aux["Loss/D/r1"] = r1
             # lazy reg: scale by interval so the *time-averaged* strength
             # matches an every-step penalty (reference trick).
             loss = loss + (t.r1_gamma * 0.5) * r1 * t.d_reg_interval
         return loss, aux
 
-    def _d_step(state: TrainState, batch_imgs, rng, do_r1: bool):
+    def _d_step(state: TrainState, batch_imgs, rng, label=None, *,
+                do_r1: bool):
         reals = normalize_images(batch_imgs)
         if cfg.data.mirror_augment:
             flip = jax.random.bernoulli(
@@ -131,17 +140,17 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
         z = _sample_z(cfg, jax.random.fold_in(rng, 0), reals.shape[0])
         grad_fn = jax.value_and_grad(d_loss_fn, has_aux=True)
         (_, aux), grads = grad_fn(state.d_params, state.g_params, reals, z,
-                                  rng, do_r1)
+                                  rng, label, do_r1)
         updates, d_opt = d_tx.update(grads, state.d_opt, state.d_params)
         d_params = optax.apply_updates(state.d_params, updates)
         return state.replace(d_params=d_params, d_opt=d_opt), aux
 
     # ---------------- G steps ----------------
 
-    def g_loss_fn(g_params, d_params, z, rng, pl_mean, do_pl: bool):
+    def g_loss_fn(g_params, d_params, z, rng, pl_mean, label, do_pl: bool):
         k_noise, k_mix = jax.random.split(jax.random.fold_in(rng, 2))
-        fakes, ws = g_forward(g_params, z, k_noise, k_mix)
-        fake_logits = D.apply({"params": d_params}, fakes)
+        fakes, ws = g_forward(g_params, z, k_noise, k_mix, label)
+        fake_logits = D.apply({"params": d_params}, fakes, label)
         loss = g_nonsaturating_loss(fake_logits)
         aux = {"Loss/G": loss}
         new_pl_mean = pl_mean
@@ -151,7 +160,9 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
             pl_batch = max(1, ws.shape[0] // max(1, t.pl_batch_shrink))
             k_pl, k_plnoise = jax.random.split(jax.random.fold_in(rng, 3))
             z_pl = _sample_z(cfg, k_pl, pl_batch)
-            ws_pl = G.apply({"params": g_params}, z_pl, method=Generator.map)
+            label_pl = None if label is None else label[:pl_batch]
+            ws_pl = G.apply({"params": g_params}, z_pl, label_pl,
+                            method=Generator.map)
 
             def synth(w):
                 return G.apply({"params": g_params}, w,
@@ -166,11 +177,12 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
             jax.lax.stop_gradient(ws).astype(jnp.float32), axis=(0, 1))
         return loss, (aux, new_pl_mean, w_batch_avg)
 
-    def _g_step(state: TrainState, rng, do_pl: bool):
+    def _g_step(state: TrainState, rng, label=None, *, do_pl: bool):
         z = _sample_z(cfg, jax.random.fold_in(rng, 5), batch)
         grad_fn = jax.value_and_grad(g_loss_fn, has_aux=True)
         (_, (aux, new_pl_mean, w_batch_avg)), grads = grad_fn(
-            state.g_params, state.d_params, z, rng, state.pl_mean, do_pl)
+            state.g_params, state.d_params, z, rng, state.pl_mean, label,
+            do_pl)
         updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)
         g_params = optax.apply_updates(state.g_params, updates)
         ema_beta = ema_beta_at(state.step)
@@ -185,16 +197,32 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
 
     # ---------------- samplers ----------------
 
-    def _sample(params, w_avg, z, rng, truncation_psi: float):
-        ws = G.apply({"params": params}, z, method=Generator.map)
+    def _sample(params, w_avg, z, rng, truncation_psi: float, label=None):
+        ws = G.apply({"params": params}, z, label, method=Generator.map)
         if truncation_psi != 1.0:
             ws = w_avg[None, None, :] + truncation_psi * (
                 ws - w_avg[None, None, :])
         return G.apply({"params": params}, ws, rngs={"noise": rng},
                        method=Generator.synthesize)
 
+    def _ppl_pairs(params, z0, z1, t, rng, epsilon: float, label=None):
+        """w-space lerp endpoints for PPL: returns images at interpolation
+        positions t and t+ε, with shared synthesis noise (the lineage's
+        sampling='full', space='w' regime)."""
+        w0 = G.apply({"params": params}, z0, label, method=Generator.map)
+        w1 = G.apply({"params": params}, z1, label, method=Generator.map)
+        tt = t[:, None, None]
+        wa = w0 + (w1 - w0) * tt
+        wb = w0 + (w1 - w0) * (tt + epsilon)
+        img_a = G.apply({"params": params}, wa, rngs={"noise": rng},
+                        method=Generator.synthesize)
+        img_b = G.apply({"params": params}, wb, rngs={"noise": rng},
+                        method=Generator.synthesize)
+        return img_a, img_b
+
     donate_state = dict(donate_argnums=(0,))
     sample = jax.jit(_sample, static_argnames=("truncation_psi",))
+    _ = env  # sharding comes from the inputs; env kept for API symmetry
     fns = TrainStepFns(
         d_step=jax.jit(functools.partial(_d_step, do_r1=False), **donate_state),
         d_step_r1=jax.jit(functools.partial(_d_step, do_r1=True), **donate_state),
@@ -202,5 +230,52 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
         g_step_pl=jax.jit(functools.partial(_g_step, do_pl=True), **donate_state),
         sample=sample,
         sample_train=sample,
+        ppl_pairs=jax.jit(_ppl_pairs, static_argnames=("epsilon",)),
     )
     return fns
+
+
+def make_metric_samplers(fns: TrainStepFns, state, cfg: ExperimentConfig,
+                         env: MeshEnv, dataset,
+                         truncation_psi: float = 1.0, seed: int = 7):
+    """(sample_fn, pair_fn) for MetricGroup.run — the ONE place that knows
+    how to drive the generator for metric sweeps: z/t/labels land sharded
+    on the data mesh axis (the generator half of a 50k sweep is
+    data-parallel, like the Inception half), batches are padded to mesh
+    divisibility and trimmed, and conditional models draw labels from the
+    dataset distribution.  Used by train/loop.py (per-tick metrics) and
+    cli/evaluate.py (snapshot metrics)."""
+    import numpy as np
+
+    bsh = env.batch()
+    rng_holder = [jax.random.PRNGKey(seed)]
+
+    def sample_fn(n):
+        rng_holder[0], k1, k2 = jax.random.split(rng_holder[0], 3)
+        m = n + (-n) % env.data_size          # pad to mesh divisibility
+        z = jax.device_put(jax.random.normal(
+            k1, (m, cfg.model.num_ws, cfg.model.latent_dim)), bsh)
+        label = (dataset.random_labels(
+            m, seed=int(jax.random.randint(k1, (), 0, 2**30)))
+            if cfg.model.label_dim else None)
+        if label is not None:
+            label = jax.device_put(label, bsh)
+        return fns.sample(state.ema_params, state.w_avg, z, k2,
+                          truncation_psi=truncation_psi, label=label)[:n]
+
+    def pair_fn(n, ts, pair_seed, epsilon):
+        k0, k1, kn = jax.random.split(jax.random.PRNGKey(pair_seed), 3)
+        m = n + (-n) % env.data_size          # pad to mesh divisibility
+        shape = (m, cfg.model.num_ws, cfg.model.latent_dim)
+        ts = np.pad(np.asarray(ts, np.float32), (0, m - n))
+        label = (dataset.random_labels(m, seed=pair_seed)
+                 if cfg.model.label_dim else None)
+        a, b = fns.ppl_pairs(
+            state.ema_params,
+            jax.device_put(jax.random.normal(k0, shape), bsh),
+            jax.device_put(jax.random.normal(k1, shape), bsh),
+            jax.device_put(ts, bsh), kn, epsilon,
+            None if label is None else jax.device_put(label, bsh))
+        return a[:n], b[:n]
+
+    return sample_fn, pair_fn

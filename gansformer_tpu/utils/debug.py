@@ -1,0 +1,35 @@
+"""NaN/Inf debugging — the XLA analog of the reference's (nonexistent)
+sanitizer story (SURVEY.md §5 "Race detection / sanitizers": TF1 serializes
+everything; under JAX the equivalent debug switch is ``jax_debug_nans``).
+
+Two layers:
+* ``enable_nan_debug()`` flips ``jax_debug_nans`` — every jitted function
+  re-runs op-by-op when a NaN appears and raises at the producing op.
+  Costly (de-optimizes dispatch), so it's a flag, not a default.
+* ``check_finite_stats()`` — cheap always-available tick-boundary guard:
+  raises ``FloatingPointError`` naming the first non-finite scalar, so a
+  diverging run dies loudly at the next tick instead of training on NaNs
+  for hours.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+
+def enable_nan_debug() -> None:
+    import jax
+
+    jax.config.update("jax_debug_nans", True)
+
+
+def check_finite_stats(stats: Dict[str, float], where: str = "") -> None:
+    """Raise FloatingPointError on the first non-finite scalar in a
+    fetched tick-stats dict."""
+    for k, v in stats.items():
+        if isinstance(v, (int, float)) and not math.isfinite(v):
+            raise FloatingPointError(
+                f"non-finite training statistic {k!r} = {v}"
+                + (f" at {where}" if where else "")
+                + "; re-run with --debug-nans to locate the producing op")

@@ -1,0 +1,83 @@
+"""Child process for the 2-process multi-host test (tests/test_multihost.py).
+
+Each process: joins the coordinator (jax.distributed.initialize), exposes 4
+virtual CPU devices (8 global), builds the global mesh, produces only its
+LOCAL shard of the batch, assembles the global array, runs one sharded
+d_step, and participates in the run-id broadcast — i.e. every multi-host
+code path of parallel/mesh.py + train/loop.py that single-process tests
+cannot reach (VERDICT r2 item 6).
+
+Not named test_*.py: pytest must not collect it.
+"""
+
+import json
+import os
+import sys
+
+# sanitized child env has no PYTHONPATH; make the repo root importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    port, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2, process_id=pid)
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    import numpy as np
+
+    from gansformer_tpu.core.config import (
+        DataConfig, ExperimentConfig, ModelConfig, TrainConfig)
+    from gansformer_tpu.parallel.mesh import local_batch_size, make_mesh
+    from gansformer_tpu.train.state import create_train_state
+    from gansformer_tpu.train.steps import make_train_steps
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(resolution=16, components=2, latent_dim=16,
+                          w_dim=16, mapping_dim=16, mapping_layers=2,
+                          fmap_base=64, fmap_max=32, attention="simplex",
+                          attn_start_res=8, attn_max_res=8,
+                          mbstd_group_size=2),
+        train=TrainConfig(batch_size=16),
+        data=DataConfig(resolution=16, source="synthetic"))
+    env = make_mesh(cfg.mesh)
+    assert env.mesh.size == 8
+
+    global_batch = 16
+    lbs = local_batch_size(global_batch, env)          # 8 per process
+    # Each process contributes a DIFFERENT local shard (seeded by pid) —
+    # the loop's per-host shard model (train/loop.py put_batch).
+    imgs_local = np.random.RandomState(pid).randint(
+        0, 255, (lbs, 16, 16, 3), dtype=np.uint8)
+    batch = jax.make_array_from_process_local_data(env.batch(), imgs_local)
+    assert batch.shape[0] == global_batch
+
+    state = create_train_state(cfg, jax.random.PRNGKey(0))
+    state = jax.device_put(state, env.replicated())
+    fns = make_train_steps(cfg, env, batch_size=global_batch)
+    state, aux = fns.d_step(state, batch, jax.random.PRNGKey(1))
+    state, g_aux = fns.g_step(state, jax.random.PRNGKey(2))
+    jax.block_until_ready(state.step)
+
+    # run-dir id broadcast (cli/train.py multi-host run-dir agreement)
+    from jax.experimental import multihost_utils
+
+    rid = multihost_utils.broadcast_one_to_all(
+        np.int32(42 if pid == 0 else 0))
+
+    leaves = jax.tree_util.tree_leaves(jax.device_get(state.d_params))
+    cks = float(sum(np.float64(np.abs(l).sum()) for l in leaves))
+    with open(os.path.join(outdir, f"p{pid}.json"), "w") as f:
+        json.dump({"rid": int(rid), "lbs": lbs, "cks": cks,
+                   "loss_d": float(jax.device_get(aux["Loss/D"])),
+                   "loss_g": float(jax.device_get(g_aux["Loss/G"]))}, f)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -194,3 +194,102 @@ def test_tfrecord_reader_throughput(tmp_path):
     # Escape hatch for known-slow machines: GANSFORMER_PERF_FLOOR=0 disables.
     floor = float(os.environ.get("GANSFORMER_PERF_FLOOR", "1600"))
     assert rate > floor, f"reader too slow: {rate:.0f} img/s @ 256x256"
+
+
+# --- TFRecord writer (VERDICT r2 item 5) ------------------------------------
+
+def test_tfrecord_writer_roundtrip_own_reader(tmp_path):
+    """Writer → reader round-trip in the reference's multi-lod layout."""
+    from gansformer_tpu.data.dataset import TFRecordDataset
+    from gansformer_tpu.data.tfrecord_writer import TFRecordExporter
+
+    res, n = 16, 10
+    imgs = np.random.RandomState(0).randint(
+        0, 255, (n, res, res, 3), dtype=np.uint8)
+    labels = np.eye(n, 5, dtype=np.float32)[np.arange(n) % 5]
+    with TFRecordExporter(str(tmp_path), "toy", res) as ex:
+        for img in imgs:
+            ex.add_image(img)
+        ex.add_labels(labels)
+    # full pyramid written: r02..r04
+    for lod in (2, 3, 4):
+        assert (tmp_path / f"toy-r{lod:02d}.tfrecords").exists()
+
+    ds = TFRecordDataset(str(tmp_path), resolution=res)
+    assert ds.resolution == res and ds.has_labels and ds.label_dim == 5
+    batch = next(ds.batches(4, seed=0))
+    assert batch["image"].shape == (4, res, res, 3)
+    assert batch["label"].shape == (4, 5)
+    originals = {imgs[i].tobytes() for i in range(n)}
+    assert batch["image"][0].tobytes() in originals
+
+    # lower lod holds box-downsampled images at the right resolution
+    ds2 = TFRecordDataset(str(tmp_path), resolution=8)
+    assert ds2.resolution == 8
+
+
+def test_tfrecord_writer_crc_and_tf_compat(tmp_path):
+    """Files must carry valid masked CRC32C framing — i.e. be readable by
+    stock tf.data exactly as the reference would read them."""
+    tf = pytest.importorskip("tensorflow")
+    from gansformer_tpu.data.tfrecord_writer import TFRecordExporter
+
+    res = 8
+    imgs = np.random.RandomState(1).randint(
+        0, 255, (4, res, res, 3), dtype=np.uint8)
+    with TFRecordExporter(str(tmp_path), "toy", res,
+                          all_lods=False) as ex:
+        for img in imgs:
+            ex.add_image(img)
+    path = str(tmp_path / "toy-r03.tfrecords")
+    got = []
+    for rec in tf.data.TFRecordDataset([path]):  # validates framing CRCs
+        ex2 = tf.train.Example.FromString(rec.numpy())
+        f = ex2.features.feature
+        shape = list(f["shape"].int64_list.value)
+        data = f["data"].bytes_list.value[0]
+        got.append(np.frombuffer(data, np.uint8).reshape(shape))
+    assert len(got) == 4
+    np.testing.assert_array_equal(got[0], imgs[0].transpose(2, 0, 1))
+
+
+def test_crc32c_known_vectors():
+    """CRC32C (Castagnoli) check against published test vectors (RFC 3720)."""
+    from gansformer_tpu.data.tfrecord_writer import crc32c
+
+    assert crc32c(b"") == 0x0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_cifar10_loader(tmp_path):
+    import pickle
+
+    rs = np.random.RandomState(0)
+    for i in range(1, 6):
+        batch = {b"data": rs.randint(0, 255, (20, 3072), dtype=np.uint8)
+                 .astype(np.uint8),
+                 b"labels": list(rs.randint(0, 10, 20))}
+        with open(tmp_path / f"data_batch_{i}", "wb") as f:
+            pickle.dump(batch, f)
+    from gansformer_tpu.data.tfrecord_writer import load_cifar10
+
+    images, labels = load_cifar10(str(tmp_path))
+    assert images.shape == (100, 32, 32, 3) and images.dtype == np.uint8
+    assert labels.shape == (100, 10)
+    np.testing.assert_allclose(labels.sum(axis=1), 1.0)
+
+
+def test_prepare_data_cli_tfrecord(tmp_path):
+    """CLI end-to-end: synthetic → reference-format tfrecords → trainable
+    dataset (the 'convert and train from the flagship preset's native
+    format' contract)."""
+    from gansformer_tpu.cli.prepare_data import main as prep
+    from gansformer_tpu.data.dataset import TFRecordDataset
+
+    out = str(tmp_path / "synth")
+    prep(["--synthetic", "--to", "tfrecord", "--out", out,
+          "--resolution", "16", "--max-images", "12"])
+    ds = TFRecordDataset(out, resolution=16)
+    batch = next(ds.batches(4, seed=0))
+    assert batch["image"].shape == (4, 16, 16, 3)

@@ -90,3 +90,114 @@ def test_metric_group_on_tiny_extractor():
     assert np.isfinite(out["fid16_uncal"]) and out["fid16_uncal"] >= 0
     assert out["is16_uncal_mean"] >= 1.0
     assert out["calibrated"] == 0.0
+
+
+def test_feature_extractor_sharded_over_mesh():
+    """The FID sweep runs data-parallel over the mesh (VERDICT r2 item 4):
+    input batches land sharded on the data axis, params replicated, and a
+    non-divisible batch is padded+trimmed.  Results match the unsharded
+    extractor exactly."""
+    import jax
+
+    from gansformer_tpu.metrics.inception import FeatureExtractor
+    from gansformer_tpu.parallel.mesh import DATA_AXIS, make_mesh
+    from gansformer_tpu.core.config import MeshConfig
+
+    env = make_mesh(MeshConfig())
+    assert env.data_size == 8  # conftest forces the 8-device CPU mesh
+    ex_mesh = FeatureExtractor(None, env=env)
+    ex_solo = FeatureExtractor(None)
+
+    rs = np.random.RandomState(0)
+    imgs = jnp.asarray(rs.rand(8, 32, 32, 3).astype(np.float32) * 2 - 1)
+    sharded = jax.device_put(imgs, env.batch())
+    spec = sharded.sharding.spec
+    assert spec and spec[0] == DATA_AXIS  # batch axis rides the mesh
+
+    f_mesh, l_mesh = ex_mesh(imgs)
+    f_solo, l_solo = ex_solo(imgs)
+    np.testing.assert_allclose(np.asarray(f_mesh), np.asarray(f_solo),
+                               rtol=2e-4, atol=2e-4)
+
+    # batch=5 doesn't divide the 8-device mesh → pad+trim path
+    f5, l5 = ex_mesh(imgs[:5])
+    assert f5.shape[0] == 5 and l5.shape[0] == 5
+    np.testing.assert_allclose(np.asarray(f5), np.asarray(f_solo)[:5],
+                               rtol=2e-4, atol=2e-4)
+
+
+# --- PPL + precision/recall (VERDICT r2 item 8) ------------------------------
+
+def test_precision_recall_identical_and_disjoint():
+    from gansformer_tpu.metrics.precision_recall import precision_recall
+
+    rs = np.random.RandomState(0)
+    a = rs.randn(256, 16).astype(np.float32)
+    p, r = precision_recall(a, a.copy(), k=3, block=64)
+    assert p == 1.0 and r == 1.0  # identical sets cover each other
+
+    far = a + 1000.0
+    p, r = precision_recall(a, far, k=3, block=64)
+    assert p == 0.0 and r == 0.0  # disjoint manifolds
+
+    # mode-dropping fake set: high precision (fakes sit on the real
+    # manifold), low recall (half the real modes uncovered)
+    reals = np.concatenate([rs.randn(200, 8), rs.randn(200, 8) + 50.0]
+                           ).astype(np.float32)
+    fakes = (rs.randn(400, 8) * 0.5).astype(np.float32)  # first mode only
+    p, r = precision_recall(reals, fakes, k=3)
+    assert p > 0.8 and r < 0.6
+
+
+def test_ppl_distance_filtering():
+    from gansformer_tpu.metrics.ppl import ppl_from_distances
+
+    d = np.ones(1000)
+    d[0] = 1e9   # outlier must be filtered by the 1%-tails rule
+    assert abs(ppl_from_distances(d) - 1.0) < 1e-6
+
+
+def test_ppl_end_to_end_tiny_generator():
+    """ppl_pairs probe + PPL metric on a micro generator: smaller ε-steps
+    through a smooth G give finite, positive path lengths."""
+    import jax
+
+    from gansformer_tpu.core.config import (
+        DataConfig, ExperimentConfig, ModelConfig, TrainConfig)
+    from gansformer_tpu.metrics.inception import FeatureExtractor
+    from gansformer_tpu.metrics.metric_base import PPLMetric
+    from gansformer_tpu.train.state import create_train_state
+    from gansformer_tpu.train.steps import make_train_steps
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(resolution=16, components=2, latent_dim=16,
+                          w_dim=16, mapping_dim=16, mapping_layers=2,
+                          fmap_base=64, fmap_max=32, attention="simplex",
+                          attn_start_res=8, attn_max_res=8),
+        train=TrainConfig(batch_size=8),
+        data=DataConfig(resolution=16, source="synthetic"))
+    state = create_train_state(cfg, jax.random.PRNGKey(0))
+    fns = make_train_steps(cfg, batch_size=8)
+    ex = FeatureExtractor(None)
+
+    def pair_fn(n, ts, seed, epsilon):
+        k0, k1, kn = jax.random.split(jax.random.PRNGKey(seed), 3)
+        shape = (n, cfg.model.num_ws, cfg.model.latent_dim)
+        return fns.ppl_pairs(state.ema_params, jax.random.normal(k0, shape),
+                             jax.random.normal(k1, shape),
+                             np.asarray(ts, np.float32), kn, epsilon)
+
+    m = PPLMetric(num_samples=16, batch_size=8, epsilon=1e-2)
+    out = m.run(None, None, ex, None, pair_fn=pair_fn)
+    (name, val), = out.items()
+    assert name == "ppl16_wfull_uncal"
+    assert np.isfinite(val) and val >= 0
+
+
+def test_parse_metric_names_ppl_pr():
+    from gansformer_tpu.metrics.metric_base import (
+        PPLMetric, PRMetric, parse_metric_names)
+
+    ms = parse_metric_names("fid1k,ppl2k,pr500", batch_size=8)
+    assert isinstance(ms[1], PPLMetric) and ms[1].num_samples == 2000
+    assert isinstance(ms[2], PRMetric) and ms[2].num_images == 500

@@ -151,10 +151,10 @@ def test_attention_style_mode():
 
 def test_ffhq1024_duplex_compiles():
     """The v4-32 flagship preset (BASELINE.json config #5) must trace AND
-    XLA-compile end-to-end at batch 1 (VERDICT r1 item 6).  Also locks the
-    param count and the compiled workspace: 40.3M params / ~242MB fp32 temp
-    at batch 1 — the basis for the no-Pallas decision (even batch-8 bf16
-    training fits v4 HBM with multiples of margin; see PERF.md)."""
+    XLA-compile end-to-end at batch 1 (VERDICT r1 item 6).  Locks the param
+    count and the forward workspace.  HBM headroom for the full TRAIN step
+    is measured separately (PERF.md §2: g_step_pl needs ~16.9 GiB temp at
+    batch 8 → fits v4's 32 GiB with ~1.8× margin, batch 4 on v5e)."""
     from gansformer_tpu.models.generator import Generator
 
     cfg = get_preset("ffhq1024-duplex")
@@ -172,8 +172,37 @@ def test_ffhq1024_duplex_compiles():
                        method=Generator.synthesize)
 
     compiled = jax.jit(fwd).lower(params, z).compile()
-    out_shape, = [s for s in jax.tree_util.tree_leaves(
-        compiled.output_shardings)] and [compiled.out_avals[0]]
+    # Output aval via eval_shape (version-safe; `Compiled` has no out_avals
+    # attribute in the installed JAX — VERDICT r2 item 2).
+    out_shape = jax.eval_shape(fwd, params, z)
     assert tuple(out_shape.shape) == (1, 1024, 1024, 3)
     temp = compiled.memory_analysis().temp_size_in_bytes
     assert temp < 2 * 1024**3, f"fwd workspace blew up: {temp/1e9:.1f} GB"
+
+
+def test_conditional_generator_and_discriminator():
+    """Label path end-to-end (VERDICT r2 item 7): the label changes G's
+    output and D's logit; D is a projection head over embed(label)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, label_dim=5)
+    g = Generator(cfg)
+    z = _z(cfg)
+    lab1 = jnp.eye(5)[jnp.array([0, 1])]
+    lab2 = jnp.eye(5)[jnp.array([2, 3])]
+    params = g.init({"params": jax.random.PRNGKey(0),
+                     "noise": jax.random.PRNGKey(1)}, z, label=lab1)
+    img1 = g.apply(params, z, label=lab1, rngs={"noise": jax.random.PRNGKey(2)})
+    img2 = g.apply(params, z, label=lab2, rngs={"noise": jax.random.PRNGKey(2)})
+    assert img1.shape == (2, 32, 32, 3)
+    assert not np.allclose(np.asarray(img1), np.asarray(img2))
+    # unconditional call must fail loudly, not silently ignore the label
+    with pytest.raises(ValueError, match="label"):
+        g.apply(params, z, rngs={"noise": jax.random.PRNGKey(2)})
+
+    d = Discriminator(cfg)
+    dp = d.init(jax.random.PRNGKey(0), img1, lab1)
+    s1 = d.apply(dp, img1, lab1)
+    s2 = d.apply(dp, img1, lab2)
+    assert s1.shape == (2, 1)
+    assert not np.allclose(np.asarray(s1), np.asarray(s2))
