@@ -136,14 +136,61 @@ class NpzDataset(Dataset):
             yield out
 
 
+_SCAN_CHUNK = 64 * 1024 * 1024
+# Files whose checksums verified on a complete pass — corruption is a
+# static property, so epochs 2+ skip the CRC work (~90 ms/GB).
+_CRC_VERIFIED: set = set()
+
+
 def _iter_tfrecord_raw(path: str) -> Iterator[bytes]:
     """Minimal TFRecord reader — no TF dependency on the hot path.
 
     Record framing (TFRecord spec): u64 length, u32 masked-crc(length),
-    payload, u32 masked-crc(payload).  CRCs are skipped (the reference's
-    reader delegates to tf.data which checks them; for training input the
+    payload, u32 masked-crc(payload).
+
+    Fast path: the native host-ops frame scanner
+    (gansformer_tpu/native) over 64 MB chunks, WITH checksum
+    verification — corruption raises instead of feeding garbage.
+    Fallback: Python framing with CRCs skipped (the reference's reader
+    delegates to tf.data which checks them; in pure Python the
     cost/benefit favors skipping).
     """
+    from gansformer_tpu import native
+
+    if native.get_lib() is not None and path not in _CRC_VERIFIED:
+        # First pass over a file: native chunked scan WITH checksums, so a
+        # corrupt dataset fails loudly up front.  Later passes use the
+        # lighter per-record framing below (still native proto parse),
+        # which measures ~2× faster in steady state.
+        verify = True
+        with open(path, "rb") as f:
+            leftover = b""
+            while True:
+                chunk = f.read(_SCAN_CHUNK)
+                buf = leftover + chunk
+                if not buf:
+                    _CRC_VERIFIED.add(path)
+                    return
+                offs, lens, consumed = native.scan_records(
+                    buf, verify_crc=verify)
+                for o, ln in zip(offs, lens):
+                    yield buf[int(o):int(o) + int(ln)]
+                leftover = buf[consumed:]
+                if not chunk:          # EOF
+                    if leftover:
+                        raise ValueError(
+                            f"truncated TFRecord at end of {path} "
+                            f"({len(leftover)} trailing bytes)")
+                    _CRC_VERIFIED.add(path)
+                    return
+                if consumed == 0 and len(buf) > 2**30:
+                    # bounds RAM if a corrupt length field claims a
+                    # multi-GB record (largest real record ≈ 3 MB at 1024²)
+                    raise ValueError(
+                        f"TFRecord record larger than 1 GiB in {path} — "
+                        f"corrupt length field?")
+        return
+
     with open(path, "rb") as f:
         while True:
             head = f.read(12)
@@ -193,8 +240,12 @@ def _walk_proto(buf: bytes):
 
 
 def _parse_example_image(payload: bytes) -> np.ndarray:
-    """Hand-rolled parse of the reference's ``tf.train.Example``
-    {shape: int64[3], data: bytes} — no TensorFlow dependency.
+    """Parse of the reference's ``tf.train.Example`` {shape: int64[3],
+    data: bytes} — no TensorFlow dependency.
+
+    Fast path: the native host-ops lib (gansformer_tpu/native, C++ proto
+    walk returning spans; images come out as zero-copy ``np.frombuffer``
+    views).  Fallback: the hand-rolled Python walk below.
 
     Proto schema (tensorflow/core/example/example.proto):
       Example.features(1) → Features.feature(1) map<string, Feature> →
@@ -203,6 +254,18 @@ def _parse_example_image(payload: bytes) -> np.ndarray:
     Raises on malformed records (corruption must be loud, not a silent
     dataset shrink).
     """
+    from gansformer_tpu import native
+
+    parsed = native.parse_example(payload) if native.get_lib() else None
+    if parsed is not None:
+        shape, d_off, d_len = parsed
+        arr = np.frombuffer(payload, np.uint8, count=d_len,
+                            offset=d_off).reshape(shape)
+        if arr.ndim == 3 and arr.shape[0] in (1, 3) and \
+                arr.shape[0] < arr.shape[2]:
+            arr = arr.transpose(1, 2, 0)  # CHW (reference layout) → HWC
+        return arr
+
     features = None
     for field, _, val in _walk_proto(payload):
         if field == 1:                      # Example.features

@@ -52,6 +52,13 @@ except ImportError:
 def crc32c(data: bytes) -> int:
     if _crc32c_native is not None:
         return int(_crc32c_native(data))
+    # this framework's own native host-ops lib (g++-at-first-use,
+    # gansformer_tpu/native): ~1.4 GB/s vs ~1 MB/s pure Python
+    from gansformer_tpu import native
+
+    val = native.crc32c(data)
+    if val is not None:
+        return val
     # Pure-Python fallback: plain-list table (several× faster per byte
     # than indexing a numpy array); datasets are written once.
     crc = 0xFFFFFFFF

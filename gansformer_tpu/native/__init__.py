@@ -1,0 +1,136 @@
+"""Native host-ops loader — the reference's ``custom_ops.get_plugin`` role
+(SURVEY.md §2.1 "Runtime kernel compiler": nvcc at first use, cached by
+source hash, loaded into the process).  Here: ``g++ -O3 -shared`` at first
+use, cached by source hash under ``~/.cache``-style dir inside the repo,
+loaded via ctypes.  Device compute stays with XLA; this covers the host
+data path (TFRecord scan/parse, CRC32C) that feeds the chips.
+
+Every entry point degrades gracefully: if no C++ toolchain is available
+the callers keep their pure-Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "host_ops.cpp")
+_CACHE = os.path.join(_DIR, "_build")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = os.path.join(_CACHE, f"host_ops-{tag}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_CACHE, exist_ok=True)
+    # atomic: build to a temp name, rename into place (concurrent procs)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CACHE)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", tmp, _SRC],
+            check=True, capture_output=True, text=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The compiled host-ops library, or None (callers use Python paths)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("GANSFORMER_TPU_NO_NATIVE") == "1":
+        return None
+    path = _compile()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.gft_crc32c.restype = ctypes.c_uint32
+    lib.gft_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.gft_scan_records.restype = ctypes.c_int64
+    lib.gft_scan_records.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t)]
+    lib.gft_parse_example.restype = ctypes.c_int
+    lib.gft_parse_example.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    _lib = lib
+    return _lib
+
+
+def crc32c(data: bytes) -> Optional[int]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    return int(lib.gft_crc32c(data, len(data)))
+
+
+def scan_records(buf: bytes, verify_crc: bool = False):
+    """(offsets, lengths, consumed) for every COMPLETE TFRecord payload in
+    ``buf``, or None if the native lib is unavailable.
+
+    ``consumed`` is the byte count covered by complete records — a partial
+    record at the tail is left unconsumed so callers can stream a file in
+    chunks.  Raises ValueError on a CRC mismatch (verify_crc)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    cap = max(16, len(buf) // 16)          # record overhead is 16 bytes
+    offs = np.empty(cap, np.int64)
+    lens = np.empty(cap, np.int64)
+    consumed = ctypes.c_size_t()
+    err_pos = ctypes.c_size_t()
+    n = lib.gft_scan_records(
+        buf, len(buf),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        cap, int(verify_crc), ctypes.byref(consumed), ctypes.byref(err_pos))
+    if n < 0:
+        raise ValueError(
+            f"corrupt TFRecord: CRC mismatch at byte {err_pos.value}")
+    return offs[:n], lens[:n], consumed.value
+
+
+def parse_example(payload: bytes):
+    """(shape tuple, data_offset, data_length) — spans within ``payload``
+    for one reference-schema Example; None if the native lib is
+    unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    shape = (ctypes.c_int64 * 4)()
+    ndim = ctypes.c_int32()
+    d_off = ctypes.c_int64()
+    d_len = ctypes.c_int64()
+    rc = lib.gft_parse_example(
+        payload, len(payload), shape, ctypes.byref(ndim),
+        ctypes.byref(d_off), ctypes.byref(d_len))
+    if rc != 0:
+        raise ValueError(f"malformed Example record (native rc={rc})")
+    return (tuple(shape[i] for i in range(ndim.value)),
+            d_off.value, d_len.value)

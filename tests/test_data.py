@@ -94,31 +94,14 @@ def test_tfrecord_reader_roundtrip(tmp_path):
 # --- input-pipeline performance & prefetch (VERDICT r1 item 4) --------------
 
 def _write_toy_records(path, imgs):
-    """Hand-framed TFRecords (CRCs zeroed — our reader skips them)."""
-    import struct
-
-    def varint(n):
-        out = b""
-        while True:
-            b7 = n & 0x7F
-            n >>= 7
-            out += bytes([b7 | (0x80 if n else 0)])
-            if not n:
-                return out
-
-    def ld(field, payload):
-        return varint((field << 3) | 2) + varint(len(payload)) + payload
+    """Hand-framed TFRecords with valid masked CRCs (the native reader
+    verifies them; the Python fallback skips them)."""
+    from gansformer_tpu.data.tfrecord_writer import (
+        encode_example_image, write_record)
 
     with open(path, "wb") as f:
         for img in imgs:
-            shape_list = ld(3, b"".join(
-                varint((1 << 3) | 0) + varint(s) for s in img.shape))
-            entry_s = ld(1, b"shape") + ld(2, shape_list)
-            bytes_list = ld(1, ld(1, img.tobytes()))
-            entry_d = ld(1, b"data") + ld(2, bytes_list)
-            payload = ld(1, ld(1, entry_s) + ld(1, entry_d))
-            f.write(struct.pack("<Q", len(payload)) + b"\0\0\0\0"
-                    + payload + b"\0\0\0\0")
+            write_record(f, encode_example_image(img))
 
 
 def test_prefetch_iterator_order_and_stop():
@@ -293,3 +276,80 @@ def test_prepare_data_cli_tfrecord(tmp_path):
     ds = TFRecordDataset(out, resolution=16)
     batch = next(ds.batches(4, seed=0))
     assert batch["image"].shape == (4, 16, 16, 3)
+
+
+# --- native host-ops (gansformer_tpu/native) ---------------------------------
+
+def test_native_host_ops_parity(tmp_path):
+    """C++ scan/parse/CRC agree with the Python implementations and with
+    the writer's output; reader transparently uses the native path."""
+    from gansformer_tpu import native
+    from gansformer_tpu.data import tfrecord_writer as w
+    from gansformer_tpu.data.dataset import TFRecordDataset
+
+    if native.get_lib() is None:
+        pytest.skip("no C++ toolchain in this environment")
+
+    # RFC 3720 vectors through the native path
+    assert native.crc32c(b"123456789") == 0xE3069283
+    assert native.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+    imgs = np.random.RandomState(3).randint(
+        0, 255, (6, 16, 16, 3), dtype=np.uint8)
+    with w.TFRecordExporter(str(tmp_path), "n", 16, all_lods=False) as ex:
+        for im in imgs:
+            ex.add_image(im)
+    buf = (tmp_path / "n-r04.tfrecords").read_bytes()
+    offs, lens, consumed = native.scan_records(buf, verify_crc=True)
+    assert len(offs) == 6 and consumed == len(buf)
+    shape, d_off, d_len = native.parse_example(
+        buf[int(offs[0]):int(offs[0]) + int(lens[0])])
+    assert shape == (3, 16, 16) and d_len == 3 * 16 * 16
+
+    # corrupt one payload byte → CRC-verified scan raises
+    bad = bytearray(buf)
+    bad[int(offs[0]) + 5] ^= 0xFF
+    with pytest.raises(ValueError, match="corrupt"):
+        native.scan_records(bytes(bad), verify_crc=True)
+
+    # hostile u64 length field must neither hang nor read OOB (the
+    # pre-fix overflow did both): it reads as a partial tail, consumed=0
+    evil = (0xFFFFFFFFFFFFFFF0).to_bytes(8, "little") + b"\0" * 20
+    o2, l2, c2 = native.scan_records(evil, verify_crc=False)
+    assert len(o2) == 0 and c2 == 0
+
+    # a truncated final record is detected by the streaming reader
+    from gansformer_tpu.data.dataset import _iter_tfrecord_raw
+    trunc = tmp_path / "trunc.tfrecords"
+    trunc.write_bytes(buf[:-3])
+    with pytest.raises(ValueError, match="truncated|corrupt"):
+        list(_iter_tfrecord_raw(str(trunc)))
+
+    # full reader round-trip rides the native parse
+    ds = TFRecordDataset(str(tmp_path), resolution=16)
+    batch = next(ds.batches(4, seed=0))
+    originals = {im.tobytes() for im in imgs}
+    assert batch["image"][0].tobytes() in originals
+
+
+def test_reader_native_matches_python_fallback(tmp_path, monkeypatch):
+    from gansformer_tpu import native as nat
+    if nat.get_lib() is None:
+        pytest.skip("no C++ toolchain — parity comparison would be vacuous")
+    from gansformer_tpu.data import dataset as dsmod
+    from gansformer_tpu.data.tfrecord_writer import TFRecordExporter
+
+    imgs = np.random.RandomState(4).randint(
+        0, 255, (4, 8, 8, 3), dtype=np.uint8)
+    with TFRecordExporter(str(tmp_path), "p", 8, all_lods=False) as ex:
+        for im in imgs:
+            ex.add_image(im)
+    path = str(tmp_path / "p-r03.tfrecords")
+    payloads = list(dsmod._iter_tfrecord_raw(path))
+    native_out = [dsmod._parse_example_image(p) for p in payloads]
+
+    from gansformer_tpu import native
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    python_out = [dsmod._parse_example_image(p) for p in payloads]
+    for a, b in zip(native_out, python_out):
+        np.testing.assert_array_equal(a, b)

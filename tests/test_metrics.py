@@ -201,3 +201,38 @@ def test_parse_metric_names_ppl_pr():
     ms = parse_metric_names("fid1k,ppl2k,pr500", batch_size=8)
     assert isinstance(ms[1], PPLMetric) and ms[1].num_samples == 2000
     assert isinstance(ms[2], PRMetric) and ms[2].num_images == 500
+
+
+def test_calibrated_fetch_attempt_is_one_shot(tmp_path, monkeypatch):
+    """try_fetch_calibrated records its outcome and never re-attempts
+    (VERDICT r2 item 2: attempt the download path once, record it)."""
+    import json
+
+    from gansformer_tpu.metrics import inception as inc
+
+    monkeypatch.setattr(inc, "_WEIGHTS_DIR", str(tmp_path))
+    monkeypatch.setattr(inc, "_CAL_NPZ", str(tmp_path / "w.npz"))
+    monkeypatch.setattr(inc, "_FETCH_OUTCOME", str(tmp_path / "o.json"))
+
+    calls = []
+
+    class FakeProc:
+        returncode = 1
+        stderr = "URL fetch failure: no network"
+
+    import subprocess as sp
+    monkeypatch.setattr(sp, "run", lambda *a, **k: calls.append(1) or FakeProc())
+    assert inc.try_fetch_calibrated() is None
+    assert json.load(open(tmp_path / "o.json"))["result"] == "failed"
+    assert inc.try_fetch_calibrated() is None   # marker short-circuits
+    assert len(calls) == 1
+
+    # a corrupt/truncated weights file must NOT be trusted (partial
+    # download from a killed converter)
+    (tmp_path / "w.npz").write_bytes(b"x")
+    assert inc.try_fetch_calibrated() is None
+
+    # a loadable weights file wins without any attempt
+    np.savez(tmp_path / "w.npz", a=np.zeros(1))
+    assert inc.try_fetch_calibrated() == str(tmp_path / "w.npz")
+    assert len(calls) == 1
