@@ -20,6 +20,9 @@ def main(argv=None) -> None:
     p.add_argument("--truncation-psi", type=float, default=0.7)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--grid", action="store_true", help="one grid PNG instead of singles")
+    p.add_argument("--save-attention", action="store_true",
+                   help="also save latent→region attention overlays "
+                        "(attn.png; needs an attention model)")
     args = p.parse_args(argv)
 
     from gansformer_tpu.core.config import ExperimentConfig
@@ -56,6 +59,38 @@ def main(argv=None) -> None:
                           truncation_psi=args.truncation_psi, label=label)
         all_imgs.append(np.asarray(jax.device_get(imgs)))
     imgs = np.concatenate(all_imgs)
+
+    if args.save_attention:
+        # Re-run one batch collecting the sown attention maps (SURVEY.md
+        # §2.3 — the paper's latent→region visualizations).
+        from gansformer_tpu.models.generator import Generator
+        from gansformer_tpu.utils.image import save_attention_grid
+
+        if cfg.model.attention == "none":
+            raise SystemExit("--save-attention needs an attention model")
+        G = Generator(cfg.model)
+        n = min(args.batch_size, args.images_num)
+        z = jax.random.normal(jax.random.fold_in(rng, 0),
+                              (n, cfg.model.num_ws, cfg.model.latent_dim))
+        label = (dataset.random_labels(n, seed=args.seed)
+                 if dataset is not None else None)
+        from gansformer_tpu.train.steps import apply_truncation
+
+        ws = G.apply({"params": state.ema_params}, z, label,
+                     method=Generator.map)
+        ws = apply_truncation(ws, state.w_avg, args.truncation_psi)
+        att_imgs, aux = G.apply(
+            {"params": state.ema_params}, ws,
+            rngs={"noise": jax.random.fold_in(rng, 1)},
+            method=Generator.synthesize, mutable=["intermediates"])
+        attn = aux["intermediates"]["synthesis"]
+        # highest attention resolution = finest region map
+        res = max(int(name[1:].split("_")[0]) for name in attn)
+        probs = np.asarray(attn[f"b{res}_attn"]["attn_probs"][0])
+        probs = probs.mean(axis=1)            # average heads → [N,h,w,k]
+        save_attention_grid(np.asarray(jax.device_get(att_imgs)), probs,
+                            os.path.join(out_dir, "attn.png"))
+        print(os.path.join(out_dir, "attn.png"))
 
     if args.grid:
         save_image_grid(imgs, os.path.join(out_dir, "grid.png"))

@@ -23,14 +23,14 @@ import numpy as np
 
 
 def _collect(args):
-    """Resolve the input source → (image iterator, count, labels|None)."""
+    """Resolve the input source → (image chunk iterator, labels|None)."""
     if args.synthetic:
         from gansformer_tpu.data.dataset import SyntheticDataset
 
         n = args.max_images or 10000
         ds = SyntheticDataset(resolution=args.resolution, num_images=n)
         idx = np.arange(n)
-        return (ds._make(idx[i:i + 64]) for i in range(0, n, 64)), n, None
+        return (ds._make(idx[i:i + 64]) for i in range(0, n, 64)), None
     if args.cifar10_dir:
         from gansformer_tpu.data.tfrecord_writer import load_cifar10
 
@@ -39,8 +39,22 @@ def _collect(args):
             raise SystemExit("CIFAR-10 is 32×32; pass --resolution 32")
         if args.max_images:
             images, labels = images[: args.max_images], labels[: args.max_images]
-        return (images[i:i + 64] for i in range(0, len(images), 64)), \
-            len(images), labels
+        return (images[i:i + 64] for i in range(0, len(images), 64)), labels
+    if args.lsun_lmdb_dir:
+        from gansformer_tpu.data.tfrecord_writer import iter_lsun_lmdb
+
+        def chunks():
+            batch = []
+            for img in iter_lsun_lmdb(args.lsun_lmdb_dir, args.resolution,
+                                      args.max_images):
+                batch.append(img)
+                if len(batch) == 64:
+                    yield np.stack(batch)
+                    batch = []
+            if batch:
+                yield np.stack(batch)
+
+        return chunks(), None
     if args.source_dir:
         from gansformer_tpu.data.dataset import ImageFolderDataset
 
@@ -51,8 +65,8 @@ def _collect(args):
             for i in range(0, len(files), 64):
                 yield np.stack([ds._load(f) for f in files[i:i + 64]])
 
-        return chunks(), len(files), None
-    return None, 0, None
+        return chunks(), None
+    return None, None
 
 
 def main(argv=None) -> None:
@@ -61,6 +75,8 @@ def main(argv=None) -> None:
                    help="directory of images (recursively scanned)")
     p.add_argument("--cifar10-dir", default=None,
                    help="extracted cifar-10-batches-py directory")
+    p.add_argument("--lsun-lmdb-dir", default=None,
+                   help="LSUN lmdb export directory (needs the lmdb pkg)")
     p.add_argument("--synthetic", action="store_true",
                    help="generate the procedural smoke dataset instead")
     p.add_argument("--to", choices=("npz", "tfrecord"), default="npz",
@@ -78,9 +94,10 @@ def main(argv=None) -> None:
                         "(skip the progressive pyramid)")
     args = p.parse_args(argv)
 
-    chunks, count, labels = _collect(args)
+    chunks, labels = _collect(args)
     if chunks is None:
-        p.error("need --source-dir, --cifar10-dir, or --synthetic")
+        p.error("need --source-dir, --cifar10-dir, --lsun-lmdb-dir, "
+                "or --synthetic")
 
     if args.to == "npz":
         imgs = np.concatenate(list(chunks))

@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int)
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans + per-tick finite checks")
+    p.add_argument("--profile-dir", default=None,
+                   help="jax.profiler trace of tick 1 → this dir "
+                        "(TensorBoard profile plugin)")
     # data overrides
     p.add_argument("--data-path", default=None)
     p.add_argument("--data-source",
@@ -81,6 +84,8 @@ def config_from_args(args) -> ExperimentConfig:
                      d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed)
     if args.debug_nans:
         train = dataclasses.replace(train, debug_nans=True)
+    if args.profile_dir:
+        train = dataclasses.replace(train, profile_dir=args.profile_dir)
     data = override(cfg.data, path=args.data_path, source=args.data_source,
                     resolution=args.resolution)
     if args.mirror_augment:

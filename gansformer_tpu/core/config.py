@@ -162,6 +162,10 @@ class TrainConfig:
     # Debug switch (SURVEY.md §5 sanitizer row): enables jax_debug_nans +
     # per-tick finite checks on the fetched loss scalars.
     debug_nans: bool = False
+    # Profiling (SURVEY.md §5 tracing row): jax.profiler trace of tick 1
+    # (steady state — past all compiles) written here for TensorBoard's
+    # profile plugin.  None = off.
+    profile_dir: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -4,5 +4,7 @@ from gansformer_tpu.data.dataset import (
     NpzDataset,
     TFRecordDataset,
     ImageFolderDataset,
+    PrefetchIterator,
     make_dataset,
 )
+from gansformer_tpu.data.tfrecord_writer import TFRecordExporter, export_images

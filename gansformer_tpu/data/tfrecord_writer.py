@@ -203,6 +203,55 @@ def export_images(images: Iterable[np.ndarray], out_dir: str, name: str,
 
 
 # ----------------------------------------------------------------------------
+# LSUN lmdb → images; the dataset_tool ``create_lsun`` role.
+# ----------------------------------------------------------------------------
+
+def iter_lsun_lmdb(lmdb_dir: str, resolution: int,
+                   max_images: Optional[int] = None):
+    """Yields HWC uint8 images centre-cropped + resized to ``resolution``
+    from an LSUN lmdb export (webp/jpg values, keys ignored).
+
+    Gated on the ``lmdb`` package (not bundled with the framework — the
+    reference's Dockerfile installs it ad hoc too); raises a clear error
+    when missing.  Undecodable records are skipped with a count, matching
+    dataset_tool's tolerance of LSUN's known corrupt entries."""
+    try:
+        import lmdb  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "LSUN conversion needs the 'lmdb' package (pip install lmdb); "
+            "it is not bundled because only the LSUN path uses it") from e
+    import io
+
+    from PIL import Image
+
+    env = lmdb.open(lmdb_dir, readonly=True, lock=False, readahead=False,
+                    meminit=False)
+    n, bad = 0, 0
+    with env.begin(write=False) as txn:
+        for _key, val in txn.cursor():
+            if max_images is not None and n >= max_images:
+                break
+            try:
+                img = Image.open(io.BytesIO(val)).convert("RGB")
+            except Exception:
+                bad += 1
+                continue
+            s = min(img.size)
+            left = (img.size[0] - s) // 2
+            top = (img.size[1] - s) // 2
+            img = img.crop((left, top, left + s, top + s))
+            img = img.resize((resolution, resolution), Image.LANCZOS)
+            yield np.asarray(img, dtype=np.uint8)
+            n += 1
+    if bad:
+        import sys
+
+        print(f"[prepare_data] skipped {bad} undecodable LSUN records",
+              file=sys.stderr)
+
+
+# ----------------------------------------------------------------------------
 # CIFAR-10 (python pickle batches) → arrays; the dataset_tool
 # ``create_cifar10`` role.
 # ----------------------------------------------------------------------------

@@ -4,4 +4,13 @@ from gansformer_tpu.metrics.fid import (
     fid_from_features,
 )
 from gansformer_tpu.metrics.inception_score import inception_score
-from gansformer_tpu.metrics.metric_base import MetricGroup, FIDMetric, ISMetric
+from gansformer_tpu.metrics.metric_base import (
+    MetricGroup,
+    FIDMetric,
+    ISMetric,
+    PPLMetric,
+    PRMetric,
+    parse_metric_names,
+)
+from gansformer_tpu.metrics.precision_recall import precision_recall
+from gansformer_tpu.metrics.ppl import ppl_from_distances

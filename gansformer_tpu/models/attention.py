@@ -97,7 +97,12 @@ class BipartiteAttention(nn.Module):
         q_x = EqualDense(att, dtype=self.dtype, name="q_x")(grid_qk) + pos
         k_y = EqualDense(att, dtype=self.dtype, name="k_y")(y.astype(self.dtype))
         v_y = EqualDense(att, dtype=self.dtype, name="v_y")(y.astype(self.dtype))
-        out, _ = multihead_attention(q_x, k_y, v_y, self.num_heads)
+        out, probs = multihead_attention(q_x, k_y, v_y, self.num_heads)
+        # Region-assignment maps [N, heads, n, k] — the GANsformer paper's
+        # attention visualizations; collected only when callers apply with
+        # mutable=['intermediates'] (zero cost otherwise).
+        self.sow("intermediates", "attn_probs",
+                 probs.reshape(n, self.num_heads, h, w, k))
 
         if self.integration == "add":
             grid = grid + EqualDense(c, dtype=self.dtype, name="o_proj")(out)

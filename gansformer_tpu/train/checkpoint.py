@@ -38,18 +38,32 @@ def _manager(ckpt_dir: str, max_to_keep: int = 5):
 
 
 def save(ckpt_dir: str, state: TrainState, cfg: Optional[ExperimentConfig] = None,
-         max_to_keep: int = 5) -> None:
+         max_to_keep: int = 5, block: bool = True) -> None:
+    """``block=False`` → async save (SURVEY.md §5: Orbax async
+    checkpointing): device buffers are staged and the write happens on
+    Orbax's background threads, so the train loop's tick stall is the
+    staging cost only.  Orbax serializes with any still-pending previous
+    save internally.  Call ``wait(ckpt_dir)`` (or a blocking save) before
+    reading ``latest_step`` for dedupe/shutdown."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(ckpt_dir, max_to_keep)
     step = int(jax.device_get(state.step))
     mgr.save(step, args=ocp.args.StandardSave(state))
-    mgr.wait_until_finished()
+    if block:
+        mgr.wait_until_finished()
     if cfg is not None:
         cfg_path = os.path.join(ckpt_dir, "config.json")
         if not os.path.exists(cfg_path):
             with open(cfg_path, "w") as f:
                 f.write(cfg.to_json())
+
+
+def wait(ckpt_dir: str) -> None:
+    """Block until any in-flight async save for this directory completes."""
+    key = os.path.abspath(ckpt_dir)
+    if key in _MANAGERS:
+        _MANAGERS[key].wait_until_finished()
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:

@@ -158,6 +158,10 @@ def train(cfg: ExperimentConfig, run_dir: str,
     # Constructed HERE, directly inside the try, so the producer thread can
     # never leak if anything earlier raises.
     batches = PrefetchIterator(batch_iter, depth=cfg.data.prefetch)
+    # jax.profiler trace of tick 1 (SURVEY.md §5 tracing row): tick 0 pays
+    # the compiles, tick 1 is steady state — that's the window worth seeing
+    # in TensorBoard's profile plugin.
+    profiling = False
     try:
         while cur_nimg < total_kimg * 1000:
             batch = next(batches)
@@ -204,13 +208,23 @@ def train(cfg: ExperimentConfig, run_dir: str,
                 tick_start_nimg = cur_nimg
                 tick_start_time = time.time()
 
+                if t.profile_dir and tick == 1 and not profiling:
+                    jax.profiler.start_trace(t.profile_dir)
+                    profiling = True
+                    log.write(f"profiler: tracing tick 1 → {t.profile_dir}")
+                elif profiling:
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    log.write("profiler: trace complete")
+
                 if tick % t.image_snapshot_ticks == 0:
                     snapshot_images(state, cur_nimg / 1000)
                 if tick % t.snapshot_ticks == 0:
                     # Orbax save() runs a cross-host barrier internally —
                     # every process must call it (gating on process 0 would
-                    # deadlock a multi-host run).
-                    ckpt.save(ckpt_dir, state, cfg)
+                    # deadlock a multi-host run).  Async: the tick only pays
+                    # the staging cost; the write rides Orbax's threads.
+                    ckpt.save(ckpt_dir, state, cfg, block=False)
                     log.write(f"checkpoint @ {cur_nimg / 1000:.1f} kimg")
                 if t.metric_ticks > 0 and t.metrics and \
                         tick % t.metric_ticks == 0:
@@ -221,10 +235,13 @@ def train(cfg: ExperimentConfig, run_dir: str,
                         cur_nimg / 1000,
                         {k: round(v, 3) for k, v in results.items()}))
     finally:
+        if profiling:
+            jax.profiler.stop_trace()
         batches.close()
 
     # final snapshot + checkpoint (skip a re-save of an already-saved step)
     snapshot_images(state, cur_nimg / 1000)
+    ckpt.wait(ckpt_dir)   # settle async saves before reading latest_step
     if ckpt.latest_step(ckpt_dir) != int(jax.device_get(state.step)):
         ckpt.save(ckpt_dir, state, cfg)
     log.write(f"done: {cur_nimg / 1000:.1f} kimg")

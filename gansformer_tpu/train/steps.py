@@ -67,6 +67,16 @@ def _sample_z(cfg, rng, batch):
     return jax.random.normal(rng, (batch, m.num_ws, m.latent_dim), jnp.float32)
 
 
+def apply_truncation(ws: jax.Array, w_avg: jax.Array,
+                     truncation_psi: float) -> jax.Array:
+    """The truncation trick (reference w_avg EMA + ψ cutoff, SURVEY.md
+    §2.3) — THE definition; every sampler (jitted eval sampler, generate
+    CLI, attention-overlay path) must go through it."""
+    if truncation_psi == 1.0:
+        return ws
+    return w_avg[None, None, :] + truncation_psi * (ws - w_avg[None, None, :])
+
+
 def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
                      batch_size: Optional[int] = None) -> TrainStepFns:
     m, t = cfg.model, cfg.train
@@ -199,9 +209,7 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
 
     def _sample(params, w_avg, z, rng, truncation_psi: float, label=None):
         ws = G.apply({"params": params}, z, label, method=Generator.map)
-        if truncation_psi != 1.0:
-            ws = w_avg[None, None, :] + truncation_psi * (
-                ws - w_avg[None, None, :])
+        ws = apply_truncation(ws, w_avg, truncation_psi)
         return G.apply({"params": params}, ws, rngs={"noise": rng},
                        method=Generator.synthesize)
 

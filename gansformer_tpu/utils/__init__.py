@@ -1,2 +1,3 @@
-from gansformer_tpu.utils.image import save_image_grid, to_uint8
+from gansformer_tpu.utils.image import (
+    save_image_grid, to_uint8, attention_overlay, save_attention_grid)
 from gansformer_tpu.utils.logging import RunLogger

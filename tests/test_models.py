@@ -206,3 +206,31 @@ def test_conditional_generator_and_discriminator():
     s2 = d.apply(dp, img1, lab2)
     assert s1.shape == (2, 1)
     assert not np.allclose(np.asarray(s1), np.asarray(s2))
+
+
+def test_attention_probs_intermediates_and_overlay():
+    """Attention blocks sow latent→region maps (the GANsformer paper's
+    visualization); maps are row-stochastic over k and the overlay util
+    renders them."""
+    from gansformer_tpu.utils.image import attention_overlay
+
+    net = SynthesisNetwork(TINY)
+    ws = jnp.zeros((2, TINY.num_ws, TINY.w_dim))
+    params = net.init({"params": jax.random.PRNGKey(0),
+                       "noise": jax.random.PRNGKey(1)}, ws)
+    img, aux = net.apply(params, ws, rngs={"noise": jax.random.PRNGKey(2)},
+                         mutable=["intermediates"])
+    inter = aux["intermediates"]
+    for res in TINY.attn_resolutions():
+        probs = np.asarray(inter[f"b{res}_attn"]["attn_probs"][0])
+        assert probs.shape == (2, TINY.num_heads, res, res, TINY.components)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-3)
+
+    top = max(TINY.attn_resolutions())
+    probs = np.asarray(inter[f"b{top}_attn"]["attn_probs"][0]).mean(axis=1)
+    overlay = attention_overlay(np.asarray(img), probs)
+    assert overlay.shape == (2, 32, 32, 3) and overlay.dtype == np.uint8
+
+    # normal apply (no mutable) is unaffected
+    img2 = net.apply(params, ws, rngs={"noise": jax.random.PRNGKey(2)})
+    np.testing.assert_array_equal(np.asarray(img), np.asarray(img2))
