@@ -18,6 +18,10 @@ def main(argv=None) -> None:
                    help="override metric sample count (e.g. 1000 for smoke)")
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--truncation-psi", type=float, default=1.0)
+    p.add_argument("--attention-backend", default=None,
+                   choices=("xla", "pallas"),
+                   help="override the attention compute backend for the "
+                        "metric sweep (forward-only)")
     p.add_argument("--inception-npz", default=None)
     p.add_argument("--cache-dir", default=None)
     args = p.parse_args(argv)
@@ -34,6 +38,13 @@ def main(argv=None) -> None:
         cfg = ExperimentConfig.from_json(f.read())
     template = create_train_state(cfg, jax.random.PRNGKey(0))
     state = ckpt.restore(os.path.join(args.run_dir, "checkpoints"), template)
+    if args.attention_backend:
+        # Forward-only sweep may use the fused pallas kernels; the template
+        # above already initialized on xla (identical param tree).
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+            cfg.model, attention_backend=args.attention_backend))
     fns = make_train_steps(cfg, batch_size=args.batch_size)
     dataset = make_dataset(cfg.data)
 

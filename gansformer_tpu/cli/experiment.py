@@ -1,0 +1,211 @@
+"""Replication-study experiment harness (SURVEY.md §2.2 "Repro-study
+harness" row).
+
+The reference repo is the code artifact of *"Investigating GANsformer"*
+(arXiv 2303.08577, PAPERS.md): a fixed-budget comparison of the StyleGAN2
+baseline against GANsformer-Simplex and GANsformer-Duplex.  This CLI runs
+that experiment matrix — one training arm per architecture under an
+otherwise identical config — and writes a comparison report, so a user of
+the reference can reproduce the study's structure on TPU with one command.
+
+Example
+-------
+  python -m gansformer_tpu.cli.experiment --preset clevr64-simplex \\
+      --archs none,simplex,duplex --total-kimg 100 --out results/repro
+
+Each arm lands in ``<out>/<arch>/`` as an ordinary run dir (stats.jsonl,
+checkpoints, fakes grids), so every per-run tool (generate, evaluate,
+--resume) works on the arms individually.  The cross-arm summary lands in
+``<out>/experiment.json`` + ``<out>/report.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import List, Optional
+
+
+ARCH_CHOICES = ("none", "simplex", "duplex")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="GANsformer replication matrix")
+    p.add_argument("--preset", default="clevr64-simplex",
+                   help="base config preset; arms override `attention` only")
+    p.add_argument("--archs", default="none,simplex,duplex",
+                   help="comma list from {none,simplex,duplex} "
+                        "(none = StyleGAN2 baseline)")
+    p.add_argument("--out", required=True, help="experiment root dir")
+    p.add_argument("--total-kimg", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--resolution", type=int, default=None)
+    p.add_argument("--components", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--data-path", default=None)
+    p.add_argument("--data-source",
+                   choices=["synthetic", "npz", "tfrecord", "folder"])
+    p.add_argument("--metrics", default=None,
+                   help="optional metric names to run per arm after "
+                        "training (e.g. fid10k_uncal)")
+    p.add_argument("--config", default=None,
+                   help="JSON base config instead of --preset")
+    return p
+
+
+def _arm_config(base, arch: str):
+    """One matrix arm: the base config with only the architecture swapped
+    (and a per-arch style_mode — attention-driven styling is meaningless
+    for the baseline)."""
+    model = dataclasses.replace(
+        base.model, attention=arch,
+        style_mode=("global" if arch == "none" else base.model.style_mode))
+    return dataclasses.replace(base, name=f"{base.name}-{arch}", model=model)
+
+
+def _run_arm_metrics(cfg, state, run_dir: str, metrics: str) -> dict:
+    """Post-training metric pass for one arm — same machinery as the
+    evaluate CLI (sharded Inception sweep over the mesh)."""
+    import jax
+
+    from gansformer_tpu.data.dataset import make_dataset
+    from gansformer_tpu.metrics.inception import make_extractor
+    from gansformer_tpu.metrics.metric_base import (
+        MetricGroup, parse_metric_names)
+    from gansformer_tpu.parallel.mesh import make_mesh
+    from gansformer_tpu.train.steps import (
+        make_metric_samplers, make_train_steps)
+
+    env = make_mesh(cfg.mesh)
+    fns = make_train_steps(cfg, batch_size=cfg.train.batch_size)
+    dataset = make_dataset(cfg.data)
+    group = MetricGroup(
+        parse_metric_names(metrics, batch_size=cfg.train.batch_size),
+        make_extractor(env=env),
+        cache_dir=os.path.join(run_dir, "metric-cache"))
+    state = jax.device_put(state, env.replicated())
+    sample_fn, pair_fn = make_metric_samplers(
+        fns, state, cfg, env, dataset, truncation_psi=1.0, seed=7)
+    return group.run(sample_fn, dataset, pair_fn=pair_fn)
+
+
+def _last_stats(run_dir: str) -> dict:
+    last = {}
+    path = os.path.join(run_dir, "stats.jsonl")
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    last = json.loads(line)
+    return last
+
+
+def run_experiment(base, archs: List[str], out: str,
+                   metrics: Optional[str] = None) -> dict:
+    import jax
+
+    from gansformer_tpu.train.loop import train
+    from gansformer_tpu.train.state import param_count
+    from gansformer_tpu.utils.logging import RunLogger
+
+    os.makedirs(out, exist_ok=True)
+    results = {}
+    for arch in archs:
+        cfg = _arm_config(base, arch)
+        run_dir = os.path.join(out, arch)
+        os.makedirs(run_dir, exist_ok=True)
+        with open(os.path.join(run_dir, "config.json"), "w") as f:
+            f.write(cfg.to_json())
+        logger = RunLogger(run_dir, active=jax.process_index() == 0)
+        logger.write(f"=== arm {arch}: {cfg.name} ===")
+        state = train(cfg, run_dir, logger=logger)
+        stats = _last_stats(run_dir)
+        arm = {
+            "run_dir": run_dir,
+            "g_params": param_count(state.g_params),
+            "d_params": param_count(state.d_params),
+            "kimg": stats.get("Progress/kimg"),
+            "loss_g": stats.get("Loss/G"),
+            "loss_d": stats.get("Loss/D"),
+            "img_per_sec": stats.get("timing/img_per_sec"),
+        }
+        if metrics:
+            try:
+                arm["metrics"] = _run_arm_metrics(cfg, state, run_dir, metrics)
+            except Exception as e:  # metric deps (weights) may be absent
+                arm["metrics_error"] = f"{type(e).__name__}: {e}"
+        results[arch] = arm
+        logger.close()
+
+    summary = {"base_preset": base.name, "archs": archs, "arms": results}
+    with open(os.path.join(out, "experiment.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    _write_report(out, summary)
+    return summary
+
+
+def _write_report(out: str, summary: dict) -> None:
+    lines = [
+        "# Replication-matrix report",
+        "",
+        f"Base preset: `{summary['base_preset']}` — one arm per architecture "
+        "(the arXiv 2303.08577 study design: StyleGAN2 baseline vs "
+        "GANsformer simplex vs duplex under an identical budget).",
+        "",
+        "| arch | G params | D params | kimg | Loss/G | Loss/D | img/s |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for arch in summary["archs"]:
+        a = summary["arms"][arch]
+        fmt = lambda v, spec=".3f": (format(v, spec)
+                                     if isinstance(v, (int, float)) else "—")
+        lines.append(
+            f"| {arch} | {a['g_params']:,} | {a['d_params']:,} "
+            f"| {fmt(a.get('kimg'), '.1f')} | {fmt(a.get('loss_g'))} "
+            f"| {fmt(a.get('loss_d'))} "
+            f"| {fmt(a.get('img_per_sec'), '.1f')} |")
+        if a.get("metrics"):
+            for name, value in a["metrics"].items():
+                lines.append(f"|   ↳ {name} | {value:.4f} | | | | | |")
+    lines.append("")
+    with open(os.path.join(out, "report.md"), "w") as f:
+        f.write("\n".join(lines))
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    archs = [a.strip() for a in args.archs.split(",") if a.strip()]
+    for a in archs:
+        if a not in ARCH_CHOICES:
+            raise SystemExit(f"unknown arch {a!r}; choose from {ARCH_CHOICES}")
+
+    from gansformer_tpu.core.config import ExperimentConfig, get_preset
+
+    if args.config:
+        with open(args.config) as f:
+            base = ExperimentConfig.from_json(f.read())
+    else:
+        base = get_preset(args.preset)
+
+    def override(obj, **kv):
+        kv = {k: v for k, v in kv.items() if v is not None}
+        return dataclasses.replace(obj, **kv) if kv else obj
+
+    base = dataclasses.replace(
+        base,
+        model=override(base.model, resolution=args.resolution,
+                       components=args.components),
+        train=override(base.train, total_kimg=args.total_kimg,
+                       batch_size=args.batch_size, seed=args.seed),
+        data=override(base.data, path=args.data_path,
+                      source=args.data_source,
+                      resolution=args.resolution),
+    )
+    run_experiment(base, archs, args.out, metrics=args.metrics)
+
+
+if __name__ == "__main__":
+    main()

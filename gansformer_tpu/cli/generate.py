@@ -20,6 +20,11 @@ def main(argv=None) -> None:
     p.add_argument("--truncation-psi", type=float, default=0.7)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--grid", action="store_true", help="one grid PNG instead of singles")
+    p.add_argument("--attention-backend", default=None,
+                   choices=("xla", "pallas"),
+                   help="override the attention compute backend for this "
+                        "forward-only run ('pallas' = fused blockwise "
+                        "kernels; incompatible with --save-attention)")
     p.add_argument("--save-attention", action="store_true",
                    help="also save latent→region attention overlays "
                         "(attn.png; needs an attention model)")
@@ -33,8 +38,18 @@ def main(argv=None) -> None:
 
     with open(os.path.join(args.run_dir, "config.json")) as f:
         cfg = ExperimentConfig.from_json(f.read())
+    # Template init always runs the xla backend (param trees are identical);
+    # the backend override only touches the sampling step functions.
     template = create_train_state(cfg, jax.random.PRNGKey(0))
     state = ckpt.restore(os.path.join(args.run_dir, "checkpoints"), template)
+    if args.attention_backend:
+        import dataclasses
+
+        if args.save_attention and args.attention_backend != "xla":
+            raise SystemExit(
+                "--save-attention needs the xla backend (pallas sows no maps)")
+        cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+            cfg.model, attention_backend=args.attention_backend))
     fns = make_train_steps(cfg, batch_size=args.batch_size)
 
     dataset = None

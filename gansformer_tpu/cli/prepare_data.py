@@ -10,8 +10,10 @@ Two output formats:
   ``data/tfrecord_writer.py``; files carry valid masked-CRC framing so
   they are readable by stock ``tf.data`` and the reference itself.
 
-Downloads are out of scope in an airgapped image; point ``--source-dir`` /
-``--cifar10-dir`` at data you already have.
+``--download <name>`` fetches a benchmark dataset first (resumable,
+sha-verified — ``data/download.py``; ``--mirror-url`` points at an internal
+mirror when the default host is unreachable, e.g. an airgapped TPU pod),
+then converts it like any local source.
 """
 
 from __future__ import annotations
@@ -20,6 +22,49 @@ import argparse
 import os
 
 import numpy as np
+
+
+def _resolve_download(args) -> None:
+    """--download <name>: fetch + extract, then rewrite args so the rest of
+    the pipeline sees an ordinary local source."""
+    import glob as _glob
+
+    from gansformer_tpu.data.download import extracted_dir, fetch_dataset
+
+    name = args.download
+    cache = args.download_dir or os.path.join(
+        os.path.dirname(args.out) or ".", ".downloads")
+
+    def progress(done, total):
+        if total:
+            print(f"\r{name}: {done / 1e6:.1f}/{total / 1e6:.1f} MB",
+                  end="", flush=True)
+
+    src = fetch_dataset(name, cache, base_url=args.mirror_url,
+                        progress=progress,
+                        verify=not args.download_no_verify)
+    print()
+    root = extracted_dir(name, cache)
+    if src.post == "cifar10":
+        hits = _glob.glob(os.path.join(root, "**", "data_batch_1"),
+                          recursive=True)
+        if not hits:
+            raise SystemExit(f"downloaded {name} but found no CIFAR batches "
+                             f"under {root}")
+        if args.resolution not in (None, 32):
+            raise SystemExit("CIFAR-10 is 32×32; drop --resolution or "
+                             "pass --resolution 32")
+        args.cifar10_dir = os.path.dirname(hits[0])
+        args.resolution = 32
+    elif src.post == "lmdb":
+        hits = _glob.glob(os.path.join(root, "**", "data.mdb"),
+                          recursive=True)
+        if not hits:
+            raise SystemExit(f"downloaded {name} but found no lmdb "
+                             f"(data.mdb) under {root}")
+        args.lsun_lmdb_dir = os.path.dirname(hits[0])
+    else:
+        args.source_dir = root
 
 
 def _collect(args):
@@ -79,6 +124,16 @@ def main(argv=None) -> None:
                    help="LSUN lmdb export directory (needs the lmdb pkg)")
     p.add_argument("--synthetic", action="store_true",
                    help="generate the procedural smoke dataset instead")
+    p.add_argument("--download", default=None,
+                   help="fetch a benchmark dataset first (cifar10, clevr, "
+                        "lsun-bedroom; ffhq/cityscapes print manual steps)")
+    p.add_argument("--download-dir", default=None,
+                   help="archive cache (default: <out dir>/.downloads)")
+    p.add_argument("--mirror-url", default=None,
+                   help="override the download host (internal mirror)")
+    p.add_argument("--download-no-verify", action="store_true",
+                   help="skip the registry sha256 check (only for mirrors "
+                        "that re-packed the archive)")
     p.add_argument("--to", choices=("npz", "tfrecord"), default="npz",
                    help="output format (tfrecord = reference layout)")
     p.add_argument("--out", required=True,
@@ -87,17 +142,22 @@ def main(argv=None) -> None:
     p.add_argument("--name", default=None,
                    help="dataset name for tfrecord filenames "
                         "(default: basename of --out)")
-    p.add_argument("--resolution", type=int, default=256)
+    p.add_argument("--resolution", type=int, default=None,
+                   help="output resolution (default 256; cifar10 pins 32)")
     p.add_argument("--max-images", type=int, default=None)
     p.add_argument("--max-lod-only", action="store_true",
                    help="write only the full-resolution tfrecord file "
                         "(skip the progressive pyramid)")
     args = p.parse_args(argv)
 
+    if args.download:
+        _resolve_download(args)
+    if args.resolution is None:
+        args.resolution = 256
     chunks, labels = _collect(args)
     if chunks is None:
         p.error("need --source-dir, --cifar10-dir, --lsun-lmdb-dir, "
-                "or --synthetic")
+                "--download, or --synthetic")
 
     if args.to == "npz":
         imgs = np.concatenate(list(chunks))

@@ -58,6 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
     # mesh / multi-host (replaces reference --num-gpus)
     p.add_argument("--mesh-data", type=int, default=-1,
                    help="data-axis size; -1 = all devices")
+    p.add_argument("--mesh-model", type=int, default=1,
+                   help="model-axis size (sequence/context parallelism "
+                        "shards attention grids over this axis)")
+    p.add_argument("--sequence-parallel", action="store_true",
+                   help="shard every attention block's H*W grid axis over "
+                        "the model mesh axis (needs --mesh-model > 1)")
     p.add_argument("--coordinator", default=None,
                    help="host:port for jax.distributed.initialize")
     p.add_argument("--num-processes", type=int, default=None)
@@ -79,6 +85,10 @@ def config_from_args(args) -> ExperimentConfig:
     model = override(cfg.model, attention=args.attention,
                      components=args.components, resolution=args.resolution,
                      dtype=args.dtype)
+    if getattr(args, "sequence_parallel", False):
+        if getattr(args, "mesh_model", 1) <= 1:
+            raise SystemExit("--sequence-parallel needs --mesh-model > 1")
+        model = dataclasses.replace(model, sequence_parallel=True)
     train = override(cfg.train, batch_size=args.batch_size,
                      total_kimg=args.total_kimg, g_lr=args.g_lr,
                      d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed)
@@ -91,6 +101,7 @@ def config_from_args(args) -> ExperimentConfig:
     if args.mirror_augment:
         data = dataclasses.replace(data, mirror_augment=True)
     mesh = MeshConfig(data=args.mesh_data,
+                      model=getattr(args, "mesh_model", 1),
                       coordinator_address=args.coordinator,
                       num_processes=args.num_processes,
                       process_id=args.process_id)

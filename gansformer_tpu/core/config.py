@@ -76,6 +76,16 @@ class ModelConfig:
     # Duplex: latents first update themselves from the grid (k-means-like
     # centroid step), then the grid attends back.
     kmeans_iters: int = 1
+    # Sequence/context parallelism: shard the n = H·W grid axis of every
+    # attention block over the mesh's model axis (SURVEY.md §2.4 SP row).
+    # Needs mesh.model > 1 and an ambient ``jax.sharding.set_mesh``; the
+    # trainer and dryrun arrange both.
+    sequence_parallel: bool = False
+    # 'xla' | 'pallas' — attention compute backend.  'pallas' uses the fused
+    # blockwise kernels (ops/pallas_attention.py): forward-only, so it is
+    # for sampling / metric sweeps (generate/evaluate --attention-backend),
+    # never the training step.
+    attention_backend: str = "xla"
 
     # --- discriminator -----------------------------------------------------
     mbstd_group_size: int = 4

@@ -1,0 +1,189 @@
+"""Dataset download/cache — the role of the reference's
+``prepare_data.py`` downloads + ``dnnlib.util.open_url`` cache
+(SURVEY.md §2.2 "Dataset build/download CLI", §3.4; the requests/Pillow pins
+at /root/reference/src/Dockerfile:10-11 exist for exactly this path).
+
+Stdlib-only (urllib): streaming download to a ``.part`` file with Range
+resume, sha256 verification, then atomic rename — a partial or corrupt
+download can never be mistaken for a finished one.  The benchmark-dataset
+registry records a direct URL where one exists and honest manual
+instructions where the license forbids automation (the reference cannot
+automate Cityscapes either — it requires a login).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import urllib.error
+import urllib.request
+import zipfile
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+
+@dataclass(frozen=True)
+class DatasetSource:
+    """One downloadable benchmark dataset (BASELINE.json:7-11 configs)."""
+
+    name: str
+    url: Optional[str]            # None → manual-download-only
+    filename: str                 # archive name under the cache dir
+    sha256: Optional[str] = None  # verified when known
+    manual: Optional[str] = None  # instructions when url is None
+    post: Optional[str] = None    # 'cifar10' | 'images' | 'lmdb' — how
+                                  # prepare_data consumes the extracted tree
+
+
+DATASETS: Dict[str, DatasetSource] = {
+    "cifar10": DatasetSource(
+        name="cifar10",
+        url="https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz",
+        filename="cifar-10-python.tar.gz",
+        sha256="6d958be074577803d12ecdefd02955f39262c83c16fe9348329d7fe0b5c001ce",
+        post="cifar10"),
+    "clevr": DatasetSource(
+        name="clevr",
+        url="https://dl.fbaipublicfiles.com/clevr/CLEVR_v1.0.zip",
+        filename="CLEVR_v1.0.zip",
+        post="images"),
+    "lsun-bedroom": DatasetSource(
+        name="lsun-bedroom",
+        url="http://dl.yf.io/lsun/scenes/bedroom_train_lmdb.zip",
+        filename="bedroom_train_lmdb.zip",
+        post="lmdb"),
+    "ffhq": DatasetSource(
+        name="ffhq", url=None, filename="",
+        manual="FFHQ ships via Google Drive quota-gated links; download "
+               "images1024x1024 from github.com/NVlabs/ffhq-dataset and "
+               "point --source-dir at the folder."),
+    "cityscapes": DatasetSource(
+        name="cityscapes", url=None, filename="",
+        manual="Cityscapes requires a registered login "
+               "(cityscapes-dataset.com); download leftImg8bit_trainvaltest "
+               "and point --source-dir at the folder."),
+}
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def download(url: str, dest: str, sha256: Optional[str] = None,
+             chunk: int = 1 << 20,
+             progress: Optional[Callable[[int, Optional[int]], None]] = None,
+             timeout: float = 60.0) -> str:
+    """Stream ``url`` → ``dest`` with resume + integrity.
+
+    Partial data lives in ``dest + '.part'``; an interrupted download resumes
+    with a Range request.  Only after the (optional) sha256 check passes is
+    the file atomically renamed to ``dest`` — readers can trust any file
+    that exists under its final name.
+    """
+    if os.path.exists(dest):
+        if sha256 and sha256_file(dest) != sha256:
+            raise IOError(f"{dest} exists but fails its sha256 check; "
+                          f"delete it to re-download")
+        return dest
+    os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+    part = dest + ".part"
+    offset = os.path.getsize(part) if os.path.exists(part) else 0
+    req = urllib.request.Request(url)
+    if offset:
+        req.add_header("Range", f"bytes={offset}-")
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+    except urllib.error.HTTPError as e:
+        if e.code == 416:  # range past EOF — .part is stale garbage
+            os.remove(part)
+            return download(url, dest, sha256, chunk, progress, timeout)
+        raise
+    mode = "ab" if offset and resp.status == 206 else "wb"
+    if mode == "wb":
+        offset = 0  # server ignored the range; start over
+    total = resp.headers.get("Content-Length")
+    total = (int(total) + offset) if total is not None else None
+    with resp, open(part, mode) as f:
+        while True:
+            b = resp.read(chunk)
+            if not b:
+                break
+            f.write(b)
+            offset += len(b)
+            if progress:
+                progress(offset, total)
+    if sha256:
+        got = sha256_file(part)
+        if got != sha256:
+            os.remove(part)
+            raise IOError(f"sha256 mismatch for {url}: got {got}, "
+                          f"want {sha256} (partial discarded)")
+    os.replace(part, dest)
+    return dest
+
+
+def extract(archive: str, out_dir: str) -> str:
+    """tar/zip → ``out_dir`` (idempotent via a .extracted marker)."""
+    marker = os.path.join(out_dir, ".extracted-" +
+                          os.path.basename(archive))
+    if os.path.exists(marker):
+        return out_dir
+    os.makedirs(out_dir, exist_ok=True)
+    if archive.endswith(".zip"):
+        with zipfile.ZipFile(archive) as z:
+            z.extractall(out_dir)
+    elif archive.endswith((".tar.gz", ".tgz", ".tar")):
+        with tarfile.open(archive) as t:
+            t.extractall(out_dir, filter="data")
+    else:
+        raise ValueError(f"unknown archive type: {archive}")
+    with open(marker, "w") as f:
+        f.write("ok\n")
+    return out_dir
+
+
+def fetch_dataset(name: str, cache_dir: str,
+                  base_url: Optional[str] = None,
+                  progress: Optional[Callable] = None,
+                  verify: bool = True) -> DatasetSource:
+    """Download + extract a registry dataset into ``cache_dir/<name>/``.
+
+    ``base_url`` overrides the registry host (tests run a loopback HTTP
+    server; an airgapped TPU pod can point at an internal mirror).  The
+    registry sha256 is verified regardless of which host served the bytes —
+    a mirror carries the *same* file; pass ``verify=False`` only for a
+    mirror that re-packed the archive (CLI: ``--download-no-verify``).
+    Returns the source record; the extracted tree is
+    ``cache_dir/<name>/extracted``.
+    """
+    if name not in DATASETS:
+        raise SystemExit(
+            f"unknown dataset {name!r}; known: {sorted(DATASETS)}")
+    src = DATASETS[name]
+    if src.url is None:
+        raise SystemExit(f"{name} cannot be auto-downloaded: {src.manual}")
+    url = src.url
+    if base_url:
+        url = base_url.rstrip("/") + "/" + src.filename
+    root = os.path.join(cache_dir, name)
+    if verify and src.sha256 is None:
+        print(f"warning: no registry sha256 for {name!r} — downloaded bytes "
+              f"cannot be integrity-checked", flush=True)
+    archive = download(url, os.path.join(root, src.filename),
+                       sha256=src.sha256 if verify else None,
+                       progress=progress)
+    extract(archive, os.path.join(root, "extracted"))
+    return src
+
+
+def extracted_dir(name: str, cache_dir: str) -> str:
+    return os.path.join(cache_dir, name, "extracted")

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from gansformer_tpu.models.layers import EqualDense
 from gansformer_tpu.ops import multihead_attention, sinusoidal_grid_encoding
+from gansformer_tpu.parallel.mesh import MODEL_AXIS
 
 
 def _instance_norm(x: jax.Array, axis: int = 1, eps: float = 1e-8) -> jax.Array:
@@ -50,6 +51,46 @@ class BipartiteAttention(nn.Module):
     kmeans_iters: int = 1
     pos_encoding: str = "sinusoidal"   # 'sinusoidal' | 'learned' | 'none'
     dtype: jnp.dtype = jnp.float32
+    # Sequence/context parallelism: shard the n = H·W grid axis over the
+    # mesh's model axis via GSPMD constraints (batch stays on the data axis).
+    # The duplex centroid softmax then spans shards; XLA inserts exactly the
+    # pmax/psum collectives that ``ops.attention.sharded_multihead_attention``
+    # writes by hand (tests hold the two to parity).  Requires an ambient
+    # mesh (``jax.sharding.set_mesh``) when enabled.
+    grid_shard: bool = False
+    # 'xla' (jnp composite, differentiable — the training path) or 'pallas'
+    # (fused blockwise kernels, forward-only — sampling/metric sweeps;
+    # ops/pallas_attention.py).  Pallas path sows no probability maps.
+    backend: str = "xla"
+
+    def _attend(self, q, k, v):
+        """(out, probs|None) via the configured backend."""
+        if self.backend == "pallas":
+            from gansformer_tpu.ops.pallas_attention import (
+                multihead_attention_pallas)
+            interpret = jax.default_backend() != "tpu"
+            return multihead_attention_pallas(
+                q, k, v, self.num_heads, interpret=interpret), None
+        return multihead_attention(q, k, v, self.num_heads)
+
+    def _constrain(self, t: jax.Array) -> jax.Array:
+        """Pin a [N, n, ...] grid tensor's n axis to the model mesh axis.
+
+        The batch dim stays UNCONSTRAINED: the main step batches are data-
+        sharded, but the path-length phase synthesizes at batch//pl_shrink,
+        which may not divide the data axis — GSPMD picks per-caller.
+
+        No-op when no ambient mesh (or one without a model axis) is active:
+        a checkpoint trained with sequence_parallel=True must still sample
+        on a single chip from the plain generate/evaluate CLIs."""
+        if not self.grid_shard:
+            return t
+        from jax.sharding import PartitionSpec as P, get_abstract_mesh
+        mesh = get_abstract_mesh()
+        if mesh.empty or MODEL_AXIS not in mesh.axis_names:
+            return t
+        spec = P(P.UNCONSTRAINED, MODEL_AXIS, *([None] * (t.ndim - 2)))
+        return jax.lax.with_sharding_constraint(t, spec)
 
     @nn.compact
     def __call__(self, x: jax.Array, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -59,7 +100,7 @@ class BipartiteAttention(nn.Module):
         att = self.grid_dim  # attention width
         assert att % self.num_heads == 0
 
-        grid = x.reshape(n, h * w, c)
+        grid = self._constrain(x.reshape(n, h * w, c))
 
         # Positional encodings enter the grid's QUERIES/KEYS only (content
         # stream stays position-free, as values carry content).
@@ -86,7 +127,7 @@ class BipartiteAttention(nn.Module):
                                  name=f"dup{it}_k_x")(grid_qk) + pos
                 v_x = EqualDense(self.latent_dim, dtype=self.dtype,
                                  name=f"dup{it}_v_x")(grid.astype(self.dtype))
-                upd, _ = multihead_attention(q_y, k_x, v_x, self.num_heads)
+                upd, _ = self._attend(q_y, k_x, v_x)
                 gate = EqualDense(self.latent_dim, dtype=self.dtype,
                                   name=f"dup{it}_gate")(upd)
                 y = y + jax.nn.sigmoid(gate.astype(jnp.float32)).astype(y.dtype) \
@@ -97,12 +138,14 @@ class BipartiteAttention(nn.Module):
         q_x = EqualDense(att, dtype=self.dtype, name="q_x")(grid_qk) + pos
         k_y = EqualDense(att, dtype=self.dtype, name="k_y")(y.astype(self.dtype))
         v_y = EqualDense(att, dtype=self.dtype, name="v_y")(y.astype(self.dtype))
-        out, probs = multihead_attention(q_x, k_y, v_y, self.num_heads)
+        out, probs = self._attend(q_x, k_y, v_y)
         # Region-assignment maps [N, heads, n, k] — the GANsformer paper's
         # attention visualizations; collected only when callers apply with
-        # mutable=['intermediates'] (zero cost otherwise).
-        self.sow("intermediates", "attn_probs",
-                 probs.reshape(n, self.num_heads, h, w, k))
+        # mutable=['intermediates'] (zero cost otherwise).  The pallas
+        # backend never materializes the maps (that is its point).
+        if probs is not None:
+            self.sow("intermediates", "attn_probs",
+                     probs.reshape(n, self.num_heads, h, w, k))
 
         if self.integration == "add":
             grid = grid + EqualDense(c, dtype=self.dtype, name="o_proj")(out)
@@ -113,4 +156,5 @@ class BipartiteAttention(nn.Module):
             if self.integration == "both":
                 grid = grid + EqualDense(c, dtype=self.dtype, name="o_shift")(out)
 
+        grid = self._constrain(grid)
         return grid.reshape(n, h, w, c).astype(x.dtype), y

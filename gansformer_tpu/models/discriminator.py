@@ -57,6 +57,8 @@ class Discriminator(nn.Module):
                     num_heads=cfg.num_heads, duplex=True,
                     integration=cfg.integration,
                     pos_encoding=cfg.pos_encoding,
+                    grid_shard=cfg.sequence_parallel,
+                    backend=cfg.attention_backend,
                     dtype=dtype, name=f"b{res}_attn")(x, y)
             t = EqualConv(x.shape[-1], act="lrelu", resample_filter=f,
                           dtype=dtype, name=f"b{res}_conv0")(x)

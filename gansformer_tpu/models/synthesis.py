@@ -82,6 +82,8 @@ class SynthesisNetwork(nn.Module):
                     integration=cfg.integration,
                     kmeans_iters=cfg.kmeans_iters,
                     pos_encoding=cfg.pos_encoding,
+                    grid_shard=cfg.sequence_parallel,
+                    backend=cfg.attention_backend,
                     dtype=dtype, name=f"b{res}_attn")(x, y)
                 if cfg.style_mode == "attention":
                     # ReZero-gated: scalar starts at 0 so styling begins

@@ -7,4 +7,24 @@ from gansformer_tpu.ops.upfirdn2d import (
 )
 from gansformer_tpu.ops.fused_bias_act import fused_bias_act, ACTIVATIONS
 from gansformer_tpu.ops.modulated_conv import modulated_conv2d, conv2d
-from gansformer_tpu.ops.attention import multihead_attention, sinusoidal_grid_encoding
+from gansformer_tpu.ops.attention import (
+    multihead_attention,
+    multihead_attention_kv_sharded,
+    sharded_multihead_attention,
+    sinusoidal_grid_encoding,
+)
+_PALLAS_EXPORTS = (
+    "grid_to_latent_attention",
+    "latent_to_grid_attention",
+    "multihead_attention_pallas",
+)
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): keep jax.experimental.pallas out of the default
+    # import path — only backend='pallas' callers pay for it.
+    if name in _PALLAS_EXPORTS:
+        from gansformer_tpu.ops import pallas_attention
+
+        return getattr(pallas_attention, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -5,9 +5,11 @@ components Y (k ≤ 32) and the image feature grid X (n = H·W positions).  Cost
 is O(n·k) — linear in pixels — which is the scalability property to preserve:
 on TPU this is two batched einsums plus a softmax over a tiny axis, an ideal
 MXU workload, and it shards trivially over the batch axis of the data mesh
-(SURVEY.md §5 "Long-context": no ring/Ulysses machinery is required; if
-attention were ever enabled at 1024² the n axis can be sharded with a ~50-line
-shard_map — documented decision, not built).
+(SURVEY.md §5 "Long-context": no ring/Ulysses machinery is required).  For
+long-context/sequence parallelism the n = H·W grid axis CAN be sharded:
+``multihead_attention_kv_sharded`` below is the explicit shard_map kernel
+(cross-shard-stable softmax), and ``BipartiteAttention(grid_shard=True)``
+reaches the same layout via GSPMD constraints — tests hold both to parity.
 
 Softmax statistics are computed in fp32 even under bfloat16 compute.
 """
@@ -75,3 +77,86 @@ def sinusoidal_grid_encoding(height: int, width: int, dim: int) -> np.ndarray:
         axis=-1,
     )
     return grid.reshape(height * width, dim).astype(np.float32)
+
+
+# --- Sequence/context parallelism over the grid axis -------------------------
+#
+# SURVEY.md §2.4 records the decision that GANsformer's O(n·k) attention never
+# *needs* ring attention; when the n = H·W grid axis is sharded across the
+# mesh (long-context at 1024², or a model axis used for activation
+# parallelism), the only direction that needs collectives is the duplex
+# centroid phase — latents attend OVER the sharded grid, so the softmax
+# normalizer spans shards.  This is the promised "~50-line shard_map":
+# a numerically stable cross-shard softmax (pmax for the max, psum for the
+# denominator and the value-weighted sum).  The simplex direction (grid
+# queries attend to the replicated k latents) is embarrassingly parallel and
+# needs nothing.
+
+
+def multihead_attention_kv_sharded(
+    q: jax.Array,           # [N, Lq, D]        — replicated along axis_name
+    k: jax.Array,           # [N, Lk/shard, D]  — sharded along its length axis
+    v: jax.Array,           # [N, Lk/shard, Dv] — sharded along its length axis
+    num_heads: int,
+    axis_name: str,
+) -> Tuple[jax.Array, jax.Array]:
+    """``multihead_attention`` for use INSIDE ``shard_map`` when the key/value
+    length axis is sharded across mesh axis ``axis_name``.
+
+    Returns (out [N, Lq, Dv] — identical on every shard, local probs
+    [N, heads, Lq, Lk/shard] — each shard's slice of the global row-stochastic
+    map).  Differentiable (collectives are psum/pmax, both transposable), so
+    R1/path-length second-order grads flow through unchanged.
+    """
+    n, lq, d = q.shape
+    _, lk, dv = v.shape
+    assert d % num_heads == 0 and dv % num_heads == 0
+    dh = d // num_heads
+    qh = q.reshape(n, lq, num_heads, dh).astype(jnp.float32)
+    kh = k.reshape(n, lk, num_heads, dh).astype(jnp.float32)
+    vh = v.reshape(n, lk, num_heads, dv // num_heads)
+    logits = jnp.einsum("nqhd,nkhd->nhqk", qh, kh,
+                        precision=jax.lax.Precision.HIGHEST) / math.sqrt(dh)
+    # Cross-shard-stable softmax over the sharded Lk axis.
+    m = jax.lax.pmax(jax.lax.stop_gradient(logits.max(axis=-1)), axis_name)
+    p = jnp.exp(logits - m[..., None])                    # [n,h,lq,lk_local]
+    denom = jax.lax.psum(p.sum(axis=-1), axis_name)       # [n,h,lq]
+    probs = p / denom[..., None]
+    prec = (jax.lax.Precision.HIGHEST if v.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    out = jnp.einsum("nhqk,nkhd->nqhd", probs.astype(vh.dtype), vh,
+                     precision=prec)
+    out = jax.lax.psum(out, axis_name)                    # weighted-V partials
+    return out.reshape(n, lq, dv), probs
+
+
+def sharded_multihead_attention(
+    q: jax.Array,           # [N, Lq, D]
+    k: jax.Array,           # [N, Lk, D]
+    v: jax.Array,           # [N, Lk, Dv]
+    num_heads: int,
+    mesh: jax.sharding.Mesh,
+    batch_axis: Optional[str] = "data",
+    seq_axis: str = "model",
+) -> Tuple[jax.Array, jax.Array]:
+    """Grid-axis-sharded attention as a standalone op: shards K/V's length
+    axis over ``seq_axis`` (and everyone's batch over ``batch_axis``), runs
+    the explicit-collective kernel, returns globally identical output.
+
+    The model layer reaches the same sharding via GSPMD constraints
+    (``BipartiteAttention(grid_shard=True)``); this op is the hand-written
+    equivalent that the tests hold GSPMD to parity against.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def inner(q_, k_, v_):
+        return multihead_attention_kv_sharded(q_, k_, v_, num_heads, seq_axis)
+
+    b = batch_axis
+    return shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(b, None, None), P(b, seq_axis, None), P(b, seq_axis, None)),
+        out_specs=(P(b, None, None), P(b, None, None, seq_axis)),
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,227 @@
+"""Pallas TPU kernels for the bipartite attention (SURVEY.md §2.4 "Ring
+attention / blockwise" row: blockwise kernel over the n = H·W grid axis to
+bound VMEM at high resolution — no ring needed).
+
+Two directions, two kernels:
+
+``grid_to_latent_attention``  — X←Y (the main phase): every grid position
+    attends to the k ≤ 33 latents.  The softmax axis is the tiny k, so each
+    n-block is independent: one fused kernel computes logits → softmax →
+    value mix without ever materializing the [n, k] probability map in HBM.
+    Memory traffic drops from (read q,k,v + write logits + read logits +
+    write probs + read probs + write out) to (read q,k,v + write out).
+
+``latent_to_grid_attention``  — Y←X (the duplex centroid phase): the k
+    latents attend OVER the n grid positions, so the softmax spans n.  The
+    kernel runs blockwise over n with running max / denominator / weighted
+    accumulator (the flash-attention recurrence) in VMEM scratch — VMEM use
+    is O(block_n · D) regardless of n, which is what makes 1024² (n = 1M at
+    the finest attended resolution) feasible without spilling.
+
+Both kernels are forward-path only and are wired into sampling / metric
+sweeps (``ModelConfig.attention_backend = 'pallas'``); the training path
+stays on the jnp composite (``ops.attention.multihead_attention``) because
+R1/path-length need second-order autodiff, which a ``custom_vjp`` around an
+opaque kernel would break (SURVEY.md §7.3 item 1).  Tests run the kernels in
+interpret mode on CPU against the jnp oracle; on TPU they compile natively.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # importable on CPU builds
+
+
+def _vmem():
+    return pltpu.VMEM
+
+
+# --------------------------------------------------------------------------
+# X ← Y : grid attends to latents (softmax over the tiny latent axis)
+# --------------------------------------------------------------------------
+
+def _grid_to_latent_kernel(q_ref, k_ref, v_ref, o_ref, *, scale):
+    # q: [1, bn, D]  k: [1, L, D]  v: [1, L, Dv]  o: [1, bn, Dv]
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0]
+    logits = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # [bn, L]
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.dot(p.astype(v.dtype), v,
+                preferred_element_type=jnp.float32)         # [bn, Dv]
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def grid_to_latent_attention(
+    q: jax.Array,    # [B, n, D]   (fold heads into B; D = head dim)
+    k: jax.Array,    # [B, L, D]
+    v: jax.Array,    # [B, L, Dv]
+    *,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused attention where softmax runs over the latent axis L.
+
+    Equivalent to ``softmax(q @ k.T / sqrt(D)) @ v`` — the main-phase
+    direction of ``ops.attention.multihead_attention`` (per head).
+    """
+    b, n, d = q.shape
+    _, l, dv = v.shape
+    scale = 1.0 / math.sqrt(d)
+    bn = min(block_n, n)
+    n_pad = -n % bn
+    if n_pad:
+        q = jnp.pad(q, ((0, 0), (0, n_pad), (0, 0)))
+    grid = (b, (n + n_pad) // bn)
+    out = pl.pallas_call(
+        functools.partial(_grid_to_latent_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b, n + n_pad, dv), v.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bn, d), lambda i, j: (i, j, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, l, dv), lambda i, j: (i, 0, 0),
+                         memory_space=_vmem()),
+        ],
+        out_specs=pl.BlockSpec((1, bn, dv), lambda i, j: (i, j, 0),
+                               memory_space=_vmem()),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :n]
+
+
+# --------------------------------------------------------------------------
+# Y ← X : latents attend over the grid (online softmax over the big n axis)
+# --------------------------------------------------------------------------
+
+def _latent_to_grid_kernel(q_ref, k_ref, v_ref, o_ref,
+                           m_ref, s_ref, acc_ref, *, scale, n_valid, block_n):
+    # q: [1, L, D]  k: [1, bn, D]  v: [1, bn, Dv]  o: [1, L, Dv]
+    # scratch: m [L, 1], s [L, 1], acc [L, Dv]  (flash recurrence, fp32)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        s_ref[:] = jnp.zeros_like(s_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    logits = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # [L, bn]
+    # Mask grid positions past n (zero-padding from the wrapper).
+    offs = j * block_n + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, dimension=1)
+    logits = jnp.where(offs < n_valid, logits, -jnp.inf)
+
+    m_prev = m_ref[:]                                        # [L, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+    # exp(-inf - -inf) guard: masked-out rows can keep m == -inf safely
+    # because every block contributes 0 there.
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(logits - m_new)                              # [L, bn]
+    s_ref[:] = s_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+        p, v, preferred_element_type=jnp.float32)            # [L, Dv]
+    m_ref[:] = m_new
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[:] / s_ref[:]).astype(o_ref.dtype)
+
+
+def latent_to_grid_attention(
+    q: jax.Array,    # [B, L, D]
+    k: jax.Array,    # [B, n, D]
+    v: jax.Array,    # [B, n, Dv]
+    *,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused attention where softmax runs over the grid axis n, blockwise
+    with the flash-attention online recurrence (VMEM bounded by block_n)."""
+    b, l, d = q.shape
+    _, n, dv = v.shape
+    scale = 1.0 / math.sqrt(d)
+    bn = min(block_n, n)
+    n_pad = -n % bn
+    if n_pad:
+        k = jnp.pad(k, ((0, 0), (0, n_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, n_pad), (0, 0)))
+    grid = (b, (n + n_pad) // bn)
+    kern = functools.partial(_latent_to_grid_kernel, scale=scale,
+                             n_valid=n, block_n=bn)
+    scratch = [pltpu.VMEM((l, 1), jnp.float32),
+               pltpu.VMEM((l, 1), jnp.float32),
+               pltpu.VMEM((l, dv), jnp.float32)]
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((b, l, dv), v.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, bn, d), lambda i, j: (i, j, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, bn, dv), lambda i, j: (i, j, 0),
+                         memory_space=_vmem()),
+        ],
+        out_specs=pl.BlockSpec((1, l, dv), lambda i, j: (i, 0, 0),
+                               memory_space=_vmem()),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+
+
+# --------------------------------------------------------------------------
+# Drop-in multihead wrapper matching ops.attention.multihead_attention
+# --------------------------------------------------------------------------
+
+def multihead_attention_pallas(
+    q: jax.Array,    # [N, Lq, D]
+    k: jax.Array,    # [N, Lk, D]
+    v: jax.Array,    # [N, Lk, Dv]
+    num_heads: int = 1,
+    *,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Head-folding wrapper: picks the kernel by which side is the grid.
+
+    Returns out [N, Lq, Dv] only (no probability maps — use the jnp op when
+    attention visualizations are being collected).
+    """
+    n, lq, d = q.shape
+    _, lk, dv = v.shape
+    assert d % num_heads == 0 and dv % num_heads == 0
+    dh, dvh = d // num_heads, dv // num_heads
+
+    def fold(t, dim):
+        return (t.reshape(n, t.shape[1], num_heads, dim)
+                .transpose(0, 2, 1, 3)
+                .reshape(n * num_heads, t.shape[1], dim))
+
+    qf, kf, vf = fold(q, dh), fold(k, dh), fold(v, dvh)
+    if lq >= lk:      # grid queries, latent keys → softmax over tiny Lk
+        of = grid_to_latent_attention(qf, kf, vf, block_n=block_n,
+                                      interpret=interpret)
+    else:             # latent queries, grid keys → online softmax over Lk
+        of = latent_to_grid_attention(qf, kf, vf, block_n=block_n,
+                                      interpret=interpret)
+    return (of.reshape(n, num_heads, lq, dvh)
+            .transpose(0, 2, 1, 3)
+            .reshape(n, lq, dv))

@@ -57,6 +57,13 @@ class MeshEnv:
         sh = self.batch()
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
 
+    def activate(self):
+        """Context manager installing this mesh as the ambient mesh, so
+        bare-``PartitionSpec`` sharding constraints (the sequence-parallel
+        grid sharding in ``models/attention.py``) resolve inside ``jit``."""
+        from jax.sharding import set_mesh
+        return set_mesh(self.mesh)
+
 
 def init_distributed(cfg: MeshConfig) -> None:
     """Form the multi-host process group (no-op for single-process runs).

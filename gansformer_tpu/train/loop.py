@@ -49,8 +49,19 @@ def train(cfg: ExperimentConfig, run_dir: str,
           resume: bool = False,
           total_kimg: Optional[int] = None,
           logger: Optional[RunLogger] = None) -> TrainState:
-    t = cfg.train
     env = env or make_mesh(cfg.mesh)
+    # Ambient mesh for the whole run: sequence-parallel grid constraints
+    # (ModelConfig.sequence_parallel) resolve bare PartitionSpecs against it.
+    with env.activate():
+        return _train(cfg, run_dir, env, resume, total_kimg, logger)
+
+
+def _train(cfg: ExperimentConfig, run_dir: str,
+           env: MeshEnv,
+           resume: bool = False,
+           total_kimg: Optional[int] = None,
+           logger: Optional[RunLogger] = None) -> TrainState:
+    t = cfg.train
     log = logger or RunLogger(run_dir)
     total_kimg = total_kimg if total_kimg is not None else t.total_kimg
     if t.debug_nans:
@@ -217,9 +228,9 @@ def train(cfg: ExperimentConfig, run_dir: str,
                     profiling = False
                     log.write("profiler: trace complete")
 
-                if tick % t.image_snapshot_ticks == 0:
+                if t.image_snapshot_ticks and tick % t.image_snapshot_ticks == 0:
                     snapshot_images(state, cur_nimg / 1000)
-                if tick % t.snapshot_ticks == 0:
+                if t.snapshot_ticks and tick % t.snapshot_ticks == 0:
                     # Orbax save() runs a cross-host barrier internally —
                     # every process must call it (gating on process 0 would
                     # deadlock a multi-host run).  Async: the tick only pays

@@ -2,6 +2,7 @@
 
 import json
 
+import numpy as np
 import pytest
 
 from gansformer_tpu.cli.train import build_parser, config_from_args
@@ -75,3 +76,35 @@ def test_debug_nans_flag_and_finite_check():
     with pytest.raises(FloatingPointError, match="Loss/D"):
         check_finite_stats({"Loss/G": 1.0, "Loss/D": float("nan")},
                            where="kimg 3.0")
+
+
+def test_experiment_matrix(tmp_path):
+    """Repro-study harness (SURVEY.md §2.2 "Repro-study harness"): the
+    arXiv 2303.08577 matrix — baseline vs GANsformer arms under one budget —
+    runs end-to-end and writes the comparison report."""
+    import dataclasses
+    import os
+
+    from gansformer_tpu.cli.experiment import run_experiment
+    from tests.test_train import micro_cfg
+
+    base = micro_cfg(attention="simplex", batch=8)
+    # reg intervals beyond the run length: each arm compiles only the two
+    # steady-state step variants (R1/PL phases are covered in test_train).
+    base = dataclasses.replace(
+        base, train=dataclasses.replace(
+            base.train, total_kimg=1, kimg_per_tick=1, snapshot_ticks=0,
+            image_snapshot_ticks=0, d_reg_interval=10_000,
+            g_reg_interval=10_000))
+    out = str(tmp_path / "exp")
+    summary = run_experiment(base, ["none", "simplex"], out)
+    assert set(summary["arms"]) == {"none", "simplex"}
+    for arch, arm in summary["arms"].items():
+        assert arm["kimg"] and arm["kimg"] >= 1.0, arm
+        assert np.isfinite(arm["loss_g"]) and np.isfinite(arm["loss_d"])
+    # the baseline arm really is attention-free: fewer params
+    assert summary["arms"]["none"]["g_params"] < \
+        summary["arms"]["simplex"]["g_params"]
+    assert os.path.exists(os.path.join(out, "experiment.json"))
+    report = open(os.path.join(out, "report.md")).read()
+    assert "| none |" in report and "| simplex |" in report

@@ -171,3 +171,119 @@ def test_grid_encoding_static():
     assert np.isfinite(enc).all()
     # distinct positions get distinct encodings
     assert len(np.unique(enc.round(5), axis=0)) == 16
+
+
+# ------------------------------------------- sequence-parallel attention
+
+def _mesh2d(data=2, model=4):
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[: data * model]).reshape(data, model)
+    return Mesh(devs, ("data", "model"))
+
+
+def test_sharded_attention_matches_unsharded(rng):
+    """The explicit shard_map kernel (K/V length axis sharded over 'model',
+    cross-shard-stable softmax; SURVEY.md §2.4 SP row) must equal the plain
+    op bit-for-bit up to collective reduction order."""
+    n, lq, lk, d, dv, heads = 4, 6, 64, 32, 16, 2
+    q = jnp.asarray(rng.randn(n, lq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(n, lk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(n, lk, dv), jnp.float32)
+    mesh = _mesh2d()
+    ref_out, ref_probs = ops.multihead_attention(q, k, v, heads)
+    out, probs = jax.jit(
+        lambda q, k, v: ops.sharded_multihead_attention(q, k, v, heads, mesh)
+    )(q, k, v)
+    np.testing.assert_allclose(ref_out, out, atol=2e-5, rtol=1e-5)
+    np.testing.assert_allclose(ref_probs, probs, atol=1e-6, rtol=1e-5)
+    # global row-stochasticity survives the shard boundary
+    np.testing.assert_allclose(np.asarray(probs).sum(-1), 1.0, atol=1e-5)
+
+
+def test_sharded_attention_grad_parity(rng):
+    """psum/pmax collectives are transposable — first-order grads through the
+    sharded softmax must match the unsharded op (R1/PL rely on this)."""
+    n, lq, lk, d, dv, heads = 2, 3, 32, 16, 8, 1
+    q = jnp.asarray(rng.randn(n, lq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(n, lk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(n, lk, dv), jnp.float32)
+    mesh = _mesh2d()
+
+    def loss_ref(k):
+        return (ops.multihead_attention(q, k, v, heads)[0] ** 2).sum()
+
+    def loss_sharded(k):
+        return (ops.sharded_multihead_attention(
+            q, k, v, heads, mesh)[0] ** 2).sum()
+
+    g_ref = jax.grad(loss_ref)(k)
+    g_sh = jax.grad(loss_sharded)(k)
+    np.testing.assert_allclose(g_ref, g_sh, atol=2e-5, rtol=1e-4)
+
+
+# ------------------------------------------------- pallas attention kernels
+
+@pytest.mark.parametrize("shape,heads,block_n", [
+    ((2, 64, 16, 32, 32), 1, 16),    # grid->latent, n padded to blocks
+    ((2, 16, 300, 32, 16), 2, 64),   # latent->grid, masked tail block
+    ((3, 100, 8, 16, 16), 2, 512),   # block_n > n
+    ((1, 5, 257, 64, 64), 1, 128),   # latent->grid, odd n
+])
+def test_pallas_attention_matches_jnp(rng, shape, heads, block_n):
+    """Fused blockwise kernels (ops/pallas_attention.py; SURVEY.md §2.4
+    blockwise row) vs the jnp composite, interpret mode on CPU.  Covers both
+    directions: softmax-over-latents (grid queries) and the flash-style
+    online softmax over the grid axis (latent queries)."""
+    from gansformer_tpu.ops.pallas_attention import multihead_attention_pallas
+
+    n, lq, lk, d, dv = shape
+    q = jnp.asarray(rng.randn(n, lq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(n, lk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(n, lk, dv), jnp.float32)
+    ref, _ = ops.multihead_attention(q, k, v, heads)
+    out = multihead_attention_pallas(q, k, v, heads, block_n=block_n,
+                                     interpret=True)
+    np.testing.assert_allclose(ref, out, atol=3e-5, rtol=1e-5)
+
+
+def test_pallas_generator_forward_parity(rng):
+    """Same params, attention_backend 'pallas' vs 'xla': the full duplex
+    generator forward must agree (the backend only changes the attention
+    compute path, never the math)."""
+    import dataclasses
+
+    from gansformer_tpu.core.config import ModelConfig
+    from gansformer_tpu.models.generator import Generator
+
+    cfg = ModelConfig(resolution=16, components=3, latent_dim=16, w_dim=16,
+                      mapping_dim=16, mapping_layers=2, fmap_base=128,
+                      fmap_max=32, attention="duplex", attn_start_res=8,
+                      attn_max_res=16)
+    z = jnp.asarray(rng.randn(2, cfg.num_ws, cfg.latent_dim), jnp.float32)
+    noise = jax.random.PRNGKey(3)
+    G_xla = Generator(cfg)
+    params = G_xla.init({"params": jax.random.PRNGKey(0), "noise": noise}, z)
+    G_pl = Generator(dataclasses.replace(cfg, attention_backend="pallas"))
+    img_xla = G_xla.apply(params, z, rngs={"noise": noise})
+    img_pl = G_pl.apply(params, z, rngs={"noise": noise})
+    np.testing.assert_allclose(img_xla, img_pl, atol=5e-5, rtol=1e-4)
+
+
+def test_sequence_parallel_model_samples_without_mesh(rng):
+    """A checkpoint trained with sequence_parallel=True must still run a
+    plain single-device forward (generate/evaluate CLIs set no ambient
+    mesh): the grid constraint is a layout hint, skipped when no mesh (or
+    none with a model axis) is active."""
+    from gansformer_tpu.core.config import ModelConfig
+    from gansformer_tpu.models.generator import Generator
+
+    cfg = ModelConfig(resolution=16, components=2, latent_dim=16, w_dim=16,
+                      mapping_dim=16, mapping_layers=2, fmap_base=128,
+                      fmap_max=32, attention="duplex", attn_start_res=8,
+                      attn_max_res=16, sequence_parallel=True)
+    G = Generator(cfg)
+    z = jnp.asarray(rng.randn(2, cfg.num_ws, cfg.latent_dim), jnp.float32)
+    noise = jax.random.PRNGKey(1)
+    params = G.init({"params": jax.random.PRNGKey(0), "noise": noise}, z)
+    img = jax.jit(lambda p, z: G.apply(p, z, rngs={"noise": noise}))(params, z)
+    assert np.isfinite(np.asarray(img)).all()

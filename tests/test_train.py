@@ -215,3 +215,48 @@ def test_mbstd_sharding_collectives():
     assert not aligned, f"aligned groups must be shard-local: {aligned}"
     straddle = compiled_collectives(16)     # 2/shard, groups straddle
     assert "all-gather" not in straddle     # stats-only comm is acceptable
+
+
+def test_sequence_parallel_grid_sharding_parity():
+    """ModelConfig.sequence_parallel shards every attention block's n = H*W
+    grid axis over the mesh's model axis via GSPMD constraints
+    (models/attention.py _constrain).  Same params, 4x2 data-x-model mesh:
+    the full d_step_r1 + g_step_pl pair must reproduce the 1D-mesh run
+    (GSPMD is held to parity with the hand-written collective kernel, which
+    tests/test_ops.py verifies against the plain op)."""
+    results = {}
+    for sp in (False, True):
+        cfg = micro_cfg(attention="duplex")
+        cfg = dataclasses.replace(
+            cfg,
+            model=dataclasses.replace(cfg.model, sequence_parallel=sp),
+            mesh=MeshConfig(data=4, model=2) if sp else MeshConfig(data=8),
+        )
+        env = make_mesh(cfg.mesh)
+        with env.activate():
+            state = create_train_state(cfg, jax.random.PRNGKey(0))
+            state = jax.device_put(state, env.replicated())
+            fns = make_train_steps(cfg, env, batch_size=cfg.train.batch_size)
+            imgs = jax.device_put(
+                np.random.RandomState(0).randint(
+                    0, 255, (cfg.train.batch_size, 16, 16, 3), dtype=np.uint8),
+                env.batch())
+            rng = jax.random.PRNGKey(1)
+            # Both phases from the SAME initial state: after an Adam update a
+            # near-zero grad component whose sign flips under collective
+            # reduction order moves a param by a full lr, so sequential-step
+            # scalars are not comparable across mesh layouts.  d_step
+            # (first-order, full batch) + g_step_pl (second-order grads AND
+            # the pl_batch_shrink sub-batch that exercises the UNCONSTRAINED
+            # batch dim) cover both autodiff regimes at half the compile
+            # cost of the d_r1+g_pl pair.
+            state_copy = jax.tree.map(jnp.copy, state)  # steps donate buffers
+            st_d, d_aux = fns.d_step(state, imgs, jax.random.fold_in(rng, 0))
+            st_g, g_aux = fns.g_step_pl(state_copy, jax.random.fold_in(rng, 1))
+            jax.block_until_ready((st_d.step, st_g.step))
+        results[sp] = {**d_aux, **g_aux}
+    for key in results[False]:
+        a = float(jax.device_get(results[False][key]))
+        b = float(jax.device_get(results[True][key]))
+        assert np.isfinite(a) and np.isfinite(b), (key, a, b)
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-3, err_msg=key)
