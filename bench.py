@@ -78,7 +78,10 @@ def _run_inner() -> None:
     _log(f"backend up: {n_chips}x {jax.devices()[0].device_kind} ({platform})")
 
     cfg = get_preset("ffhq256-duplex")
-    batch = (8 * n_chips) if on_tpu else max(4, n_chips)
+    # GRAFT_BENCH_BATCH sweeps per-chip batch (PERF.md §1b); default 8
+    # matches the flagship preset's per-chip share.
+    per_chip = int(os.environ.get("GRAFT_BENCH_BATCH", "8"))
+    batch = (per_chip * n_chips) if on_tpu else max(4, n_chips)
     if not on_tpu:
         # CPU fallback so the bench always emits a line: tiny proxy config.
         cfg = get_preset("clevr64-simplex")

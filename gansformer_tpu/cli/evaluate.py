@@ -12,7 +12,8 @@ import jax
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="Run FID/IS on a checkpoint")
-    p.add_argument("--run-dir", required=True)
+    p.add_argument("--run-dir", required=True,
+                   help="run dir, packed run archive (.tar.gz), or URL")
     p.add_argument("--metrics", default="fid50k,is50k")
     p.add_argument("--num-images", type=int, default=None,
                    help="override metric sample count (e.g. 1000 for smoke)")
@@ -27,13 +28,11 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
 
     from gansformer_tpu.core.config import ExperimentConfig
-    from gansformer_tpu.data.dataset import make_dataset
-    from gansformer_tpu.metrics.inception import make_extractor
-    from gansformer_tpu.metrics.metric_base import MetricGroup, parse_metric_names
     from gansformer_tpu.train import checkpoint as ckpt
     from gansformer_tpu.train.state import create_train_state
-    from gansformer_tpu.train.steps import make_train_steps
+    from gansformer_tpu.utils.runarchive import resolve_run_dir
 
+    args.run_dir = resolve_run_dir(args.run_dir)
     with open(os.path.join(args.run_dir, "config.json")) as f:
         cfg = ExperimentConfig.from_json(f.read())
     template = create_train_state(cfg, jax.random.PRNGKey(0))
@@ -45,30 +44,13 @@ def main(argv=None) -> None:
 
         cfg = dataclasses.replace(cfg, model=dataclasses.replace(
             cfg.model, attention_backend=args.attention_backend))
-    fns = make_train_steps(cfg, batch_size=args.batch_size)
-    dataset = make_dataset(cfg.data)
+    from gansformer_tpu.metrics.sweep import run_metric_sweep
 
-    # --num-images overrides the sample count *at construction* so the
-    # metric name (and the metric-<name>.txt it lands in) stays honest.
-    from gansformer_tpu.parallel.mesh import make_mesh
-
-    env = make_mesh(cfg.mesh)  # FID sweep runs data-parallel over the mesh
-    metrics = parse_metric_names(args.metrics, batch_size=args.batch_size,
-                                 num_images=args.num_images)
-    group = MetricGroup(metrics, make_extractor(args.inception_npz, env=env),
-                        cache_dir=args.cache_dir or
-                        os.path.join(args.run_dir, "metric-cache"))
-
-    # replicate params over the mesh; make_metric_samplers shards z/labels
-    # so the generator half of the sweep is data-parallel too
-    from gansformer_tpu.train.steps import make_metric_samplers
-
-    state = jax.device_put(state, env.replicated())
-    sample_fn, pair_fn = make_metric_samplers(
-        fns, state, cfg, env, dataset,
-        truncation_psi=args.truncation_psi, seed=7)
-
-    results = group.run(sample_fn, dataset, pair_fn=pair_fn)
+    results = run_metric_sweep(
+        cfg, state, args.run_dir, args.metrics,
+        batch_size=args.batch_size, num_images=args.num_images,
+        truncation_psi=args.truncation_psi,
+        inception_npz=args.inception_npz, cache_dir=args.cache_dir)
     kimg = int(jax.device_get(state.step)) / 1000
     for name, val in results.items():
         print(f"{name}: {val:.4f}")

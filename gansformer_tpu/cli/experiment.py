@@ -65,32 +65,6 @@ def _arm_config(base, arch: str):
     return dataclasses.replace(base, name=f"{base.name}-{arch}", model=model)
 
 
-def _run_arm_metrics(cfg, state, run_dir: str, metrics: str) -> dict:
-    """Post-training metric pass for one arm — same machinery as the
-    evaluate CLI (sharded Inception sweep over the mesh)."""
-    import jax
-
-    from gansformer_tpu.data.dataset import make_dataset
-    from gansformer_tpu.metrics.inception import make_extractor
-    from gansformer_tpu.metrics.metric_base import (
-        MetricGroup, parse_metric_names)
-    from gansformer_tpu.parallel.mesh import make_mesh
-    from gansformer_tpu.train.steps import (
-        make_metric_samplers, make_train_steps)
-
-    env = make_mesh(cfg.mesh)
-    fns = make_train_steps(cfg, batch_size=cfg.train.batch_size)
-    dataset = make_dataset(cfg.data)
-    group = MetricGroup(
-        parse_metric_names(metrics, batch_size=cfg.train.batch_size),
-        make_extractor(env=env),
-        cache_dir=os.path.join(run_dir, "metric-cache"))
-    state = jax.device_put(state, env.replicated())
-    sample_fn, pair_fn = make_metric_samplers(
-        fns, state, cfg, env, dataset, truncation_psi=1.0, seed=7)
-    return group.run(sample_fn, dataset, pair_fn=pair_fn)
-
-
 def _last_stats(run_dir: str) -> dict:
     last = {}
     path = os.path.join(run_dir, "stats.jsonl")
@@ -111,15 +85,20 @@ def run_experiment(base, archs: List[str], out: str,
     from gansformer_tpu.train.state import param_count
     from gansformer_tpu.utils.logging import RunLogger
 
-    os.makedirs(out, exist_ok=True)
+    # Run-dir writes are process-0-only (multi-host convention of
+    # cli/train.py / train/loop.py); train() itself records each arm's
+    # RESOLVED config.json — writing an unresolved copy here would race it
+    # and could leave a wrong param-tree recipe if training crashed early.
+    is_main = jax.process_index() == 0
+    if is_main:
+        os.makedirs(out, exist_ok=True)
     results = {}
     for arch in archs:
         cfg = _arm_config(base, arch)
         run_dir = os.path.join(out, arch)
-        os.makedirs(run_dir, exist_ok=True)
-        with open(os.path.join(run_dir, "config.json"), "w") as f:
-            f.write(cfg.to_json())
-        logger = RunLogger(run_dir, active=jax.process_index() == 0)
+        if is_main:
+            os.makedirs(run_dir, exist_ok=True)
+        logger = RunLogger(run_dir, active=is_main)
         logger.write(f"=== arm {arch}: {cfg.name} ===")
         state = train(cfg, run_dir, logger=logger)
         stats = _last_stats(run_dir)
@@ -133,17 +112,21 @@ def run_experiment(base, archs: List[str], out: str,
             "img_per_sec": stats.get("timing/img_per_sec"),
         }
         if metrics:
+            from gansformer_tpu.metrics.sweep import run_metric_sweep
+
             try:
-                arm["metrics"] = _run_arm_metrics(cfg, state, run_dir, metrics)
+                arm["metrics"] = run_metric_sweep(cfg, state, run_dir,
+                                                  metrics)
             except Exception as e:  # metric deps (weights) may be absent
                 arm["metrics_error"] = f"{type(e).__name__}: {e}"
         results[arch] = arm
         logger.close()
 
     summary = {"base_preset": base.name, "archs": archs, "arms": results}
-    with open(os.path.join(out, "experiment.json"), "w") as f:
-        json.dump(summary, f, indent=2)
-    _write_report(out, summary)
+    if is_main:
+        with open(os.path.join(out, "experiment.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        _write_report(out, summary)
     return summary
 
 

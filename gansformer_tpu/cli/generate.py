@@ -13,7 +13,10 @@ import numpy as np
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="Sample images from a checkpoint")
     p.add_argument("--run-dir", required=True,
-                   help="run dir containing checkpoints/ + config.json")
+                   help="run dir containing checkpoints/ + config.json, a "
+                        "packed run archive (.tar.gz from pack_run), or an "
+                        "http(s) URL of one (the reference's pretrained-"
+                        "model loading surface)")
     p.add_argument("--out", default=None, help="output dir (default run dir)")
     p.add_argument("--images-num", type=int, default=32)
     p.add_argument("--batch-size", type=int, default=16)
@@ -35,6 +38,9 @@ def main(argv=None) -> None:
     from gansformer_tpu.train.state import create_train_state
     from gansformer_tpu.train.steps import make_train_steps
     from gansformer_tpu.utils.image import save_image_grid, to_uint8
+    from gansformer_tpu.utils.runarchive import resolve_run_dir
+
+    args.run_dir = resolve_run_dir(args.run_dir)
 
     with open(os.path.join(args.run_dir, "config.json")) as f:
         cfg = ExperimentConfig.from_json(f.read())

@@ -1,0 +1,32 @@
+"""Pack a run dir into a distributable archive — the publishing half of the
+reference's pretrained-model story (SURVEY.md §2.2: ``pretrained_networks``
+consumes snapshot pickles from URLs; ``pack_run`` produces the equivalent
+single-file artifact, which ``generate``/``evaluate --run-dir <url|tar>``
+consume).
+
+  python -m gansformer_tpu.cli.pack_run --run-dir results/00003-ffhq \\
+      [--step 25000] [--out ffhq-duplex.tar.gz]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Pack a run dir for distribution")
+    p.add_argument("--run-dir", required=True)
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: latest)")
+    p.add_argument("--out", default=None,
+                   help="output .tar.gz (default: <run>-step<N>.tar.gz)")
+    args = p.parse_args(argv)
+
+    from gansformer_tpu.utils.runarchive import pack_run
+
+    out = pack_run(args.run_dir, out_path=args.out, step=args.step)
+    print(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -86,6 +86,11 @@ class ModelConfig:
     # for sampling / metric sweeps (generate/evaluate --attention-backend),
     # never the training step.
     attention_backend: str = "xla"
+    # NO remat flag, deliberately: per-block jax.checkpoint was measured to
+    # INCREASE g_step_pl temp workspace at ffhq1024/batch-8 (16.85 →
+    # 21.20 GiB) — second-order PL grads recompute through the checkpoint
+    # boundary worse than XLA's own scheduling.  Measured result recorded
+    # in PERF.md §2b; revisit only with a profile in hand.
 
     # --- discriminator -----------------------------------------------------
     mbstd_group_size: int = 4

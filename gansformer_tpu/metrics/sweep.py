@@ -1,0 +1,71 @@
+"""The one metric-sweep entry point shared by the evaluate CLI, the
+experiment harness, and the in-loop metric hook's CLI-equivalent path.
+
+Runs the reference's §3.3 flow (SURVEY.md): build the mesh, shard the
+generator sweep and the Inception extractor over it, run the metric group.
+Also owns the eval-mesh fallback: a checkpoint trained on a larger mesh
+(e.g. ``--mesh-model 2`` sequence parallelism on a pod) must still
+evaluate on whatever devices this host has — if the saved mesh doesn't
+fit, fall back to an all-devices data-parallel mesh (the sequence-parallel
+constraint is a layout hint and no-ops on a model axis of size 1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+
+from gansformer_tpu.core.config import ExperimentConfig, MeshConfig
+
+
+def make_eval_mesh(cfg: ExperimentConfig):
+    """The run's mesh if this host can build it, else all-devices DP."""
+    from gansformer_tpu.parallel.mesh import make_mesh
+
+    try:
+        return make_mesh(cfg.mesh)
+    except ValueError:
+        return make_mesh(MeshConfig())
+
+
+def run_metric_sweep(cfg: ExperimentConfig, state, run_dir: str,
+                     metrics: str, *,
+                     batch_size: Optional[int] = None,
+                     num_images: Optional[int] = None,
+                     truncation_psi: float = 1.0,
+                     seed: int = 7,
+                     inception_npz: Optional[str] = None,
+                     cache_dir: Optional[str] = None) -> Dict[str, float]:
+    """Metric names string → results dict (``{'fid50k_uncal': …}``).
+
+    ``state`` is a host-side TrainState (restored or just trained); it is
+    replicated over the eval mesh here.  Real-data Inception activations
+    cache under ``<run_dir>/metric-cache`` unless overridden.
+    """
+    from gansformer_tpu.data.dataset import make_dataset
+    from gansformer_tpu.metrics.inception import make_extractor
+    from gansformer_tpu.metrics.metric_base import (
+        MetricGroup, parse_metric_names)
+    from gansformer_tpu.train.steps import (
+        make_metric_samplers, make_train_steps)
+
+    batch_size = batch_size or cfg.train.batch_size
+    env = make_eval_mesh(cfg)
+    fns = make_train_steps(cfg, env, batch_size=batch_size)
+    dataset = make_dataset(cfg.data)
+    # --num-images overrides the sample count *at construction* so the
+    # metric name (and the metric-<name>.txt it lands in) stays honest.
+    group = MetricGroup(
+        parse_metric_names(metrics, batch_size=batch_size,
+                           num_images=num_images),
+        make_extractor(inception_npz, env=env),
+        cache_dir=cache_dir or os.path.join(run_dir, "metric-cache"))
+    # replicate params over the mesh; make_metric_samplers shards z/labels
+    # so the generator half of the sweep is data-parallel too
+    state = jax.device_put(state, env.replicated())
+    sample_fn, pair_fn = make_metric_samplers(
+        fns, state, cfg, env, dataset,
+        truncation_psi=truncation_psi, seed=seed)
+    return group.run(sample_fn, dataset, pair_fn=pair_fn)

@@ -60,6 +60,10 @@ class SynthesisNetwork(nn.Module):
                            (1, 4, 4, cfg.nf(4)), jnp.float32)
         x = jnp.broadcast_to(const, (n, 4, 4, cfg.nf(4))).astype(dtype)
 
+        # No per-block remat here, deliberately: measured to INCREASE the
+        # second-order-grad workspace at ffhq1024 (PERF.md §2b).
+        Conv, Attn = ModulatedConv, BipartiteAttention
+
         # Running conv style: starts at the global latent; in 'attention'
         # mode each attention block folds its refined latents in, so convs
         # downstream are modulated by attention output (w_attn, §3.2).
@@ -68,14 +72,12 @@ class SynthesisNetwork(nn.Module):
         for res in cfg.block_resolutions:
             nf = cfg.nf(res)
             if res > 4:
-                x = ModulatedConv(nf, up=2, resample_filter=f, dtype=dtype,
-                                  name=f"b{res}_conv_up")(x, w_style,
-                                                          noise_mode=noise_mode)
-            x = ModulatedConv(nf, resample_filter=f, dtype=dtype,
-                              name=f"b{res}_conv")(x, w_style,
-                                                   noise_mode=noise_mode)
+                x = Conv(nf, up=2, resample_filter=f, dtype=dtype,
+                         name=f"b{res}_conv_up")(x, w_style, noise_mode)
+            x = Conv(nf, resample_filter=f, dtype=dtype,
+                     name=f"b{res}_conv")(x, w_style, noise_mode)
             if res in attn_res:
-                x, y = BipartiteAttention(
+                x, y = Attn(
                     grid_dim=nf, latent_dim=cfg.w_dim,
                     num_heads=cfg.num_heads,
                     duplex=(cfg.attention == "duplex"),

@@ -4,8 +4,10 @@ Replaces the reference's trio of ``autosummary`` → TensorBoard events,
 ``log.txt`` stdout tee, and per-tick console lines (SURVEY.md §5
 "Metrics / logging").  Design: one structured per-tick dict goes to
 (1) the console in the reference's one-line format, (2) ``stats.jsonl``
-(machine-readable; supersedes TB events with no TF dependency), and
-(3) scalar names kept reference-compatible (``Loss/G``, ``Progress/kimg``,
+(machine-readable), (3) a real TensorBoard event file under
+``<run_dir>/tensorboard/`` (dependency-free writer,
+``utils/tensorboard.py``), and (4) scalar names kept
+reference-compatible (``Loss/G``, ``Progress/kimg``,
 ``timing/img_per_sec_per_chip``) so dashboards translate 1:1.
 """
 
@@ -34,10 +36,14 @@ class RunLogger:
     def __init__(self, run_dir: str, active: bool = True):
         self.run_dir = run_dir
         self.active = active
+        self.tb = None
         if active:
             os.makedirs(run_dir, exist_ok=True)
             self.jsonl = open(os.path.join(run_dir, "stats.jsonl"), "a")
             self.log_file = open(os.path.join(run_dir, "log.txt"), "a")
+            from gansformer_tpu.utils.tensorboard import EventWriter
+
+            self.tb = EventWriter(os.path.join(run_dir, "tensorboard"))
         self.t0 = time.time()
 
     def log_tick(self, stats: Dict[str, float]) -> None:
@@ -48,6 +54,10 @@ class RunLogger:
             for k, v in stats.items()}}
         self.jsonl.write(json.dumps(rec) + "\n")
         self.jsonl.flush()
+        if self.tb is not None:
+            # global step = images seen (the lineage's x-axis convention)
+            self.tb.scalars(stats,
+                            step=int(stats.get("Progress/kimg", 0.0) * 1000))
         line = ("tick {tick:<5d} kimg {kimg:<8.1f} "
                 "time {time:<8.1f} sec/tick {sec_tick:<7.1f} "
                 "img/s {imgs:<8.1f} G {g:<6.3f} D {d:<6.3f}").format(
@@ -72,11 +82,16 @@ class RunLogger:
         if not self.active:
             return
         append_metric_line(self.run_dir, name, value, kimg)
+        if self.tb is not None:
+            self.tb.scalars({f"Metrics/{name}": value},
+                            step=int(kimg * 1000))
 
     def close(self) -> None:
         if self.active:
             self.jsonl.close()
             self.log_file.close()
+            if self.tb is not None:
+                self.tb.close()
 
 
 def list_run_dirs(results_root: str):

@@ -1,0 +1,84 @@
+"""Packed run-dir archives — the reference's pretrained-model loading
+surface (SURVEY.md §2.2 "Generate/eval CLI": ``loader.py`` /
+``pretrained_networks.py`` load a snapshot from a local path OR a URL).
+
+The reference distributes models as single pickle files; this framework's
+checkpoint is an Orbax *directory* plus ``config.json``, so the
+distributable unit is a tarball of the run dir.  ``pack_run`` creates one
+(config + latest checkpoint only — not the image grids / logs);
+``resolve_run_dir`` accepts a plain run dir, a local ``.tar.gz``, or an
+``http(s)://`` URL of one (downloaded through ``data/download.py``'s
+resumable cache) and hands back a usable run dir either way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tarfile
+from typing import Optional
+
+
+def pack_run(run_dir: str, out_path: Optional[str] = None,
+             step: Optional[int] = None) -> str:
+    """Pack ``config.json`` + one checkpoint step into a ``.tar.gz``.
+
+    Default: the latest checkpoint (the reference ships one snapshot per
+    pickle; same granularity here).
+    """
+    from gansformer_tpu.train import checkpoint as ckpt
+
+    cfg = os.path.join(run_dir, "config.json")
+    if not os.path.exists(cfg):
+        raise FileNotFoundError(f"no config.json under {run_dir}")
+    ckpt_root = os.path.join(run_dir, "checkpoints")
+    if step is None:
+        step = ckpt.latest_step(ckpt_root)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_root}")
+    step_dir = os.path.join(ckpt_root, str(step))
+    if not os.path.isdir(step_dir):
+        raise FileNotFoundError(f"no checkpoint step {step} in {ckpt_root}")
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.normpath(run_dir)) or ".",
+        f"{os.path.basename(os.path.normpath(run_dir))}-step{step}.tar.gz")
+    with tarfile.open(out_path, "w:gz") as t:
+        t.add(cfg, arcname="run/config.json")
+        t.add(step_dir, arcname=f"run/checkpoints/{step}")
+    return out_path
+
+
+def resolve_run_dir(spec: str, cache_dir: Optional[str] = None) -> str:
+    """Plain dir → itself; ``.tar(.gz)`` path or ``http(s)://`` URL → a
+    cached extraction of the packed run."""
+    if os.path.isdir(spec):
+        return spec
+    cache_dir = cache_dir or os.path.join(
+        os.path.expanduser("~"), ".cache", "gansformer_tpu", "runs")
+    if spec.startswith(("http://", "https://")):
+        from gansformer_tpu.data.download import download
+
+        key = hashlib.sha256(spec.encode()).hexdigest()[:16]
+        archive = os.path.join(cache_dir, key,
+                               os.path.basename(spec) or "run.tar.gz")
+        download(spec, archive)
+    elif os.path.isfile(spec):
+        archive = spec
+        key = hashlib.sha256(os.path.abspath(spec).encode()).hexdigest()[:16]
+    else:
+        raise FileNotFoundError(
+            f"{spec!r} is neither a run dir, an archive, nor a URL")
+    out = os.path.join(cache_dir, key, "extracted")
+    marker = os.path.join(out, ".extracted")
+    if not os.path.exists(marker):
+        os.makedirs(out, exist_ok=True)
+        with tarfile.open(archive) as t:
+            t.extractall(out, filter="data")
+        with open(marker, "w") as f:
+            f.write("ok\n")
+    run = os.path.join(out, "run")
+    if not os.path.exists(os.path.join(run, "config.json")):
+        raise FileNotFoundError(
+            f"archive {spec!r} holds no run/config.json — not a pack_run "
+            f"archive")
+    return run

@@ -1,0 +1,81 @@
+"""Micro-bench: Pallas fused attention kernels vs the jnp/XLA composite.
+
+Shapes are the flagship ffhq256-duplex attention workload (PERF.md §1):
+grid side n = H·W at the attended resolutions, k = 16 latents, C = nf(res).
+Run on the TPU chip (ambient backend); prints one JSON line per shape with
+both timings so PERF.md §1c can cite measured numbers.
+
+  python scripts/bench_pallas_attention.py [--iters 50] [--res 32 64 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def bench_one(res: int, k: int, batch: int, heads: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gansformer_tpu.core.config import get_preset
+    from gansformer_tpu.ops.attention import multihead_attention
+    from gansformer_tpu.ops.pallas_attention import multihead_attention_pallas
+
+    cfg = get_preset("ffhq256-duplex").model
+    c = cfg.nf(res)
+    n = res * res
+    dtype = jnp.bfloat16
+    rs = np.random.RandomState(0)
+    # grid→latent direction (the main phase): q from grid, k/v from latents
+    q = jnp.asarray(rs.randn(batch, n, c), dtype)
+    kk = jnp.asarray(rs.randn(batch, k, c), dtype)
+    v = jnp.asarray(rs.randn(batch, k, c), dtype)
+    interpret = jax.default_backend() != "tpu"
+
+    fns = {
+        "xla": jax.jit(lambda q, kk, v: multihead_attention(q, kk, v, heads)[0]),
+        "pallas": jax.jit(lambda q, kk, v: multihead_attention_pallas(
+            q, kk, v, heads, interpret=interpret)),
+    }
+    out = {"res": res, "n": n, "c": c, "k": k, "batch": batch,
+           "backend": jax.default_backend()}
+    ref = None
+    for name, fn in fns.items():
+        r = fn(q, kk, v)
+        jax.block_until_ready(r)
+        if ref is None:
+            ref = r
+        else:
+            err = float(jnp.max(jnp.abs(ref.astype(jnp.float32)
+                                        - r.astype(jnp.float32))))
+            out["max_abs_diff"] = err
+        t0 = time.time()
+        for _ in range(iters):
+            r = fn(q, kk, v)
+        jax.block_until_ready(r)
+        out[f"{name}_ms"] = round((time.time() - t0) / iters * 1e3, 3)
+    out["speedup"] = round(out["xla_ms"] / out["pallas_ms"], 3)
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--res", type=int, nargs="+", default=[32, 64, 128])
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--k", type=int, default=16)
+    p.add_argument("--heads", type=int, default=1)
+    args = p.parse_args()
+    for res in args.res:
+        print(json.dumps(bench_one(res, args.k, args.batch, args.heads,
+                                   args.iters)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

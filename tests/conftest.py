@@ -22,3 +22,27 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+@pytest.fixture(scope="session")
+def micro_run_dir(tmp_path_factory):
+    """ONE short end-to-end training run shared by every test that needs a
+    real run dir (tick-loop artifacts, checkpoint resume, pack/distribute):
+    compiles dominate these tests, so train once per session."""
+    import dataclasses
+
+    from gansformer_tpu.train.loop import train
+    from tests.test_train import micro_cfg
+
+    cfg = micro_cfg(attention="simplex", batch=8)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(
+            cfg.train, total_kimg=1, kimg_per_tick=1, snapshot_ticks=1,
+            image_snapshot_ticks=1))
+    d = str(tmp_path_factory.mktemp("micro_run"))
+    import os
+
+    with open(os.path.join(d, "config.json"), "w") as f:
+        f.write(cfg.to_json())
+    train(cfg, d)
+    return d

@@ -13,21 +13,9 @@ from tests.test_train import micro_cfg
 
 
 @pytest.fixture(scope="module")
-def run_dir(tmp_path_factory):
-    import dataclasses
-
-    import jax
-
-    from gansformer_tpu.train.loop import train
-
-    cfg = micro_cfg(attention="simplex", batch=8)
-    cfg = dataclasses.replace(
-        cfg, train=dataclasses.replace(
-            cfg.train, total_kimg=1, kimg_per_tick=1, snapshot_ticks=1,
-            image_snapshot_ticks=1))
-    d = str(tmp_path_factory.mktemp("run"))
-    train(cfg, d)
-    return d
+def run_dir(micro_run_dir):
+    # the shared session-scoped training run (tests/conftest.py)
+    return micro_run_dir
 
 
 def test_loop_artifacts(run_dir):

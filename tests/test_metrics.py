@@ -236,3 +236,30 @@ def test_calibrated_fetch_attempt_is_one_shot(tmp_path, monkeypatch):
     np.savez(tmp_path / "w.npz", a=np.zeros(1))
     assert inc.try_fetch_calibrated() == str(tmp_path / "w.npz")
     assert len(calls) == 1
+
+
+def test_eval_mesh_falls_back_when_run_mesh_too_big():
+    """A checkpoint trained on a bigger mesh (e.g. --mesh-model 2 on a pod)
+    must still evaluate on this host: metrics/sweep.py falls back to an
+    all-devices DP mesh when the saved layout doesn't fit."""
+    import jax
+
+    from gansformer_tpu.core.config import (
+        DataConfig, ExperimentConfig, MeshConfig, ModelConfig, TrainConfig)
+    from gansformer_tpu.metrics.sweep import make_eval_mesh
+
+    cfg = ExperimentConfig(
+        name="podrun",
+        model=ModelConfig(resolution=16, sequence_parallel=True),
+        train=TrainConfig(batch_size=8),
+        data=DataConfig(resolution=16, source="synthetic"),
+        mesh=MeshConfig(data=8, model=2),  # needs 16 devices; host has 8
+    )
+    env = make_eval_mesh(cfg)
+    assert env.mesh.size == len(jax.devices())
+    assert env.model_size == 1
+    # and when the saved mesh does fit, it is honored
+    cfg_fit = ExperimentConfig(
+        name="fits", model=cfg.model, train=cfg.train, data=cfg.data,
+        mesh=MeshConfig(data=4, model=2))
+    assert make_eval_mesh(cfg_fit).model_size == 2
