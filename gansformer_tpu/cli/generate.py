@@ -31,6 +31,15 @@ def main(argv=None) -> None:
     p.add_argument("--save-attention", action="store_true",
                    help="also save latent→region attention overlays "
                         "(attn.png; needs an attention model)")
+    p.add_argument("--interpolate", type=int, nargs=2, default=None,
+                   metavar=("ROWS", "STEPS"),
+                   help="also save latent-interpolation strips: ROWS pairs "
+                        "of endpoints, STEPS z-lerp columns (interp.png)")
+    p.add_argument("--style-mix", type=int, nargs=2, default=None,
+                   metavar=("ROWS", "COLS"),
+                   help="also save the component-mixing grid: row sources "
+                        "keep the leading latent components, column sources "
+                        "supply the suffix (mix.png)")
     args = p.parse_args(argv)
 
     from gansformer_tpu.core.config import ExperimentConfig
@@ -112,6 +121,70 @@ def main(argv=None) -> None:
         save_attention_grid(np.asarray(jax.device_get(att_imgs)), probs,
                             os.path.join(out_dir, "attn.png"))
         print(os.path.join(out_dir, "attn.png"))
+
+    if args.interpolate:
+        # Latent interpolation strips (the replication paper's smoothness
+        # figure): each row lerps z between two endpoints; columns are the
+        # interpolation steps.  Done in z-space, mapped per step — the
+        # convention of the lineage's interpolation videos.
+        rows, steps = args.interpolate
+        za = jax.random.normal(jax.random.fold_in(rng, 101),
+                               (rows, cfg.model.num_ws, cfg.model.latent_dim))
+        zb = jax.random.normal(jax.random.fold_in(rng, 202),
+                               (rows, cfg.model.num_ws, cfg.model.latent_dim))
+        label = (dataset.random_labels(rows, seed=args.seed + 7)
+                 if dataset is not None else None)
+        strip = []
+        for s in range(steps):
+            t = s / max(steps - 1, 1)
+            zt = (1.0 - t) * za + t * zb
+            imgs_t = fns.sample(state.ema_params, state.w_avg, zt,
+                                jax.random.fold_in(rng, 303),
+                                truncation_psi=args.truncation_psi,
+                                label=label)
+            strip.append(np.asarray(jax.device_get(imgs_t)))
+        # [steps, rows, H, W, C] → row-major grid: rows × steps
+        inter = np.stack(strip, axis=1).reshape(rows * steps,
+                                                *strip[0].shape[1:])
+        save_image_grid(inter, os.path.join(out_dir, "interp.png"),
+                        grid=(steps, rows))
+        print(os.path.join(out_dir, "interp.png"))
+
+    if args.style_mix:
+        # Component-mixing grid (the mixing figure of the lineage, in this
+        # framework's per-component semantics — SURVEY.md §7.4): cell (r,c)
+        # keeps row-source r's leading latent components and takes the
+        # suffix (and the global component, if present) from column-source
+        # c.  Mapping runs once per source; mixing happens in w-space.
+        from gansformer_tpu.models.generator import Generator
+        from gansformer_tpu.train.steps import apply_truncation
+
+        rows, cols = args.style_mix
+        G = Generator(cfg.model)
+
+        def map_ws(key, n, label_seed):
+            z = jax.random.normal(key, (n, cfg.model.num_ws,
+                                        cfg.model.latent_dim))
+            label = (dataset.random_labels(n, seed=label_seed)
+                     if dataset is not None else None)
+            ws = G.apply({"params": state.ema_params}, z, label,
+                         method=Generator.map)
+            return apply_truncation(ws, state.w_avg, args.truncation_psi)
+
+        ws_a = map_ws(jax.random.fold_in(rng, 404), rows, args.seed + 11)
+        ws_b = map_ws(jax.random.fold_in(rng, 505), cols, args.seed + 12)
+        cross = max(1, cfg.model.components // 2)
+        # [rows, cols, num_ws, w] — leading components from A, rest from B
+        mix = np.broadcast_to(
+            np.asarray(ws_b)[None, :], (rows, cols) + ws_b.shape[1:]).copy()
+        mix[:, :, :cross] = np.asarray(ws_a)[:, None, :cross]
+        mixed = G.apply({"params": state.ema_params},
+                        jax.numpy.asarray(mix.reshape((-1,) + mix.shape[2:])),
+                        rngs={"noise": jax.random.fold_in(rng, 606)},
+                        method=Generator.synthesize)
+        save_image_grid(np.asarray(jax.device_get(mixed)),
+                        os.path.join(out_dir, "mix.png"), grid=(cols, rows))
+        print(os.path.join(out_dir, "mix.png"))
 
     if args.grid:
         save_image_grid(imgs, os.path.join(out_dir, "grid.png"))

@@ -90,7 +90,7 @@ class ModelConfig:
     # INCREASE g_step_pl temp workspace at ffhq1024/batch-8 (16.85 →
     # 21.20 GiB) — second-order PL grads recompute through the checkpoint
     # boundary worse than XLA's own scheduling.  Measured result recorded
-    # in PERF.md §2b; revisit only with a profile in hand.
+    # in PERF.md §2a; revisit only with a profile in hand.
 
     # --- discriminator -----------------------------------------------------
     mbstd_group_size: int = 4

@@ -61,7 +61,7 @@ class SynthesisNetwork(nn.Module):
         x = jnp.broadcast_to(const, (n, 4, 4, cfg.nf(4))).astype(dtype)
 
         # No per-block remat here, deliberately: measured to INCREASE the
-        # second-order-grad workspace at ffhq1024 (PERF.md §2b).
+        # second-order-grad workspace at ffhq1024 (PERF.md §2a).
         Conv, Attn = ModulatedConv, BipartiteAttention
 
         # Running conv style: starts at the global latent; in 'attention'

@@ -58,16 +58,22 @@ def resolve_run_dir(spec: str, cache_dir: Optional[str] = None) -> str:
     if spec.startswith(("http://", "https://")):
         from gansformer_tpu.data.download import download
 
-        key = hashlib.sha256(spec.encode()).hexdigest()[:16]
-        archive = os.path.join(cache_dir, key,
+        url_key = hashlib.sha256(spec.encode()).hexdigest()[:16]
+        archive = os.path.join(cache_dir, url_key,
                                os.path.basename(spec) or "run.tar.gz")
         download(spec, archive)
     elif os.path.isfile(spec):
         archive = spec
-        key = hashlib.sha256(os.path.abspath(spec).encode()).hexdigest()[:16]
     else:
         raise FileNotFoundError(
             f"{spec!r} is neither a run dir, an archive, nor a URL")
+    # Extraction key includes the archive's size+mtime: re-packing to the
+    # same path must invalidate the cached extraction, or metrics would
+    # silently run against the stale checkpoint.
+    st = os.stat(archive)
+    key = hashlib.sha256(
+        f"{os.path.abspath(archive)}:{st.st_size}:{st.st_mtime_ns}"
+        .encode()).hexdigest()[:16]
     out = os.path.join(cache_dir, key, "extracted")
     marker = os.path.join(out, ".extracted")
     if not os.path.exists(marker):

@@ -180,3 +180,55 @@ def test_pack_run_and_load_from_archive_and_url(tmp_path, micro_run_dir):
                                cache_dir=cache2) == resolved_url
     finally:
         srv.shutdown()
+
+    # re-packing to the SAME path must invalidate the cached extraction
+    import time
+
+    time.sleep(0.01)  # ensure a different mtime_ns
+    pack_run(run, out_path=archive)
+    resolved2 = resolve_run_dir(archive, cache_dir=cache1)
+    assert resolved2 != resolved
+    assert os.path.exists(os.path.join(resolved2, "config.json"))
+
+
+def test_evaluate_cli_end_to_end(tmp_path, micro_run_dir, capsys):
+    """evaluate CLI main() on a real run dir: restore → sharded sweep →
+    metric-<name>.txt + JSON line (reference §3.3 surface).  Uses the tiny
+    uncalibrated extractor, so names carry the honest _uncal suffix."""
+    import glob
+    import os
+
+    from gansformer_tpu.cli.evaluate import main as evaluate
+
+    evaluate(["--run-dir", micro_run_dir, "--metrics", "fid,is",
+              "--num-images", "32", "--batch-size", "16"])
+    out = capsys.readouterr().out.strip().splitlines()
+    payload = json.loads(out[-1])
+    assert any(k.startswith("fid32_uncal") for k in payload)
+    assert any(k.startswith("is32_uncal") for k in payload)
+    assert all(np.isfinite(v) for k, v in payload.items()
+               if isinstance(v, float))
+    files = glob.glob(os.path.join(micro_run_dir, "metric-*.txt"))
+    assert any("fid32_uncal" in f for f in files)
+
+
+def test_generate_cli_grid_and_interpolation(tmp_path, micro_run_dir):
+    """generate CLI: grid + latent-interpolation strips (the replication
+    paper's smoothness figure) from a real checkpoint."""
+    import os
+
+    from PIL import Image
+
+    from gansformer_tpu.cli.generate import main as generate
+
+    out = str(tmp_path / "gen")
+    generate(["--run-dir", micro_run_dir, "--grid", "--images-num", "8",
+              "--batch-size", "8", "--interpolate", "2", "5",
+              "--style-mix", "2", "3", "--out", out])
+    grid = np.asarray(Image.open(os.path.join(out, "grid.png")))
+    interp = np.asarray(Image.open(os.path.join(out, "interp.png")))
+    mix = np.asarray(Image.open(os.path.join(out, "mix.png")))
+    res = 16  # micro config resolution
+    assert interp.shape == (2 * res, 5 * res, 3)  # rows x steps tiles
+    assert mix.shape == (2 * res, 3 * res, 3)     # rows x cols tiles
+    assert grid.size and interp.std() > 0 and mix.std() > 0
