@@ -135,6 +135,10 @@ def main(argv=None) -> None:
         label = (dataset.random_labels(rows, seed=args.seed + 7)
                  if dataset is not None else None)
         strip = []
+        rows_eff = min(rows, args.batch_size)   # one sample call per step,
+        if rows_eff != rows:                    # capped by --batch-size
+            raise SystemExit(f"--interpolate ROWS ({rows}) must be "
+                             f"<= --batch-size ({args.batch_size})")
         for s in range(steps):
             t = s / max(steps - 1, 1)
             zt = (1.0 - t) * za + t * zb
@@ -178,11 +182,15 @@ def main(argv=None) -> None:
         mix = np.broadcast_to(
             np.asarray(ws_b)[None, :], (rows, cols) + ws_b.shape[1:]).copy()
         mix[:, :, :cross] = np.asarray(ws_a)[:, None, :cross]
-        mixed = G.apply({"params": state.ema_params},
-                        jax.numpy.asarray(mix.reshape((-1,) + mix.shape[2:])),
-                        rngs={"noise": jax.random.fold_in(rng, 606)},
-                        method=Generator.synthesize)
-        save_image_grid(np.asarray(jax.device_get(mixed)),
+        flat = mix.reshape((-1,) + mix.shape[2:])
+        mixed = []
+        for i in range(0, len(flat), args.batch_size):   # respect --batch-size
+            chunk = G.apply({"params": state.ema_params},
+                            jax.numpy.asarray(flat[i:i + args.batch_size]),
+                            rngs={"noise": jax.random.fold_in(rng, 606)},
+                            method=Generator.synthesize)
+            mixed.append(np.asarray(jax.device_get(chunk)))
+        save_image_grid(np.concatenate(mixed),
                         os.path.join(out_dir, "mix.png"), grid=(cols, rows))
         print(os.path.join(out_dir, "mix.png"))
 

@@ -32,21 +32,25 @@ def main() -> None:
     import numpy as np
 
     from gansformer_tpu.core.config import (
-        DataConfig, ExperimentConfig, ModelConfig, TrainConfig)
+        DataConfig, ExperimentConfig, MeshConfig, ModelConfig, TrainConfig)
     from gansformer_tpu.parallel.mesh import local_batch_size, make_mesh
     from gansformer_tpu.train.state import create_train_state
     from gansformer_tpu.train.steps import make_train_steps
 
+    # 2D mesh: 4-way data parallel x 2-way sequence/context parallel —
+    # multi-host AND the grid-axis sharding of every attention block
+    # (ModelConfig.sequence_parallel) in one exercise.
     cfg = ExperimentConfig(
         model=ModelConfig(resolution=16, components=2, latent_dim=16,
                           w_dim=16, mapping_dim=16, mapping_layers=2,
-                          fmap_base=64, fmap_max=32, attention="simplex",
+                          fmap_base=64, fmap_max=32, attention="duplex",
                           attn_start_res=8, attn_max_res=8,
-                          mbstd_group_size=2),
+                          mbstd_group_size=2, sequence_parallel=True),
         train=TrainConfig(batch_size=16),
-        data=DataConfig(resolution=16, source="synthetic"))
+        data=DataConfig(resolution=16, source="synthetic"),
+        mesh=MeshConfig(data=4, model=2))
     env = make_mesh(cfg.mesh)
-    assert env.mesh.size == 8
+    assert env.mesh.size == 8 and env.model_size == 2
 
     global_batch = 16
     lbs = local_batch_size(global_batch, env)          # 8 per process
@@ -57,12 +61,13 @@ def main() -> None:
     batch = jax.make_array_from_process_local_data(env.batch(), imgs_local)
     assert batch.shape[0] == global_batch
 
-    state = create_train_state(cfg, jax.random.PRNGKey(0))
-    state = jax.device_put(state, env.replicated())
-    fns = make_train_steps(cfg, env, batch_size=global_batch)
-    state, aux = fns.d_step(state, batch, jax.random.PRNGKey(1))
-    state, g_aux = fns.g_step(state, jax.random.PRNGKey(2))
-    jax.block_until_ready(state.step)
+    with env.activate():   # ambient mesh for the SP grid constraints
+        state = create_train_state(cfg, jax.random.PRNGKey(0))
+        state = jax.device_put(state, env.replicated())
+        fns = make_train_steps(cfg, env, batch_size=global_batch)
+        state, aux = fns.d_step(state, batch, jax.random.PRNGKey(1))
+        state, g_aux = fns.g_step(state, jax.random.PRNGKey(2))
+        jax.block_until_ready(state.step)
 
     # run-dir id broadcast (cli/train.py multi-host run-dir agreement)
     from jax.experimental import multihost_utils

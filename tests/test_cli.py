@@ -182,10 +182,11 @@ def test_pack_run_and_load_from_archive_and_url(tmp_path, micro_run_dir):
         srv.shutdown()
 
     # re-packing to the SAME path must invalidate the cached extraction
-    import time
-
-    time.sleep(0.01)  # ensure a different mtime_ns
     pack_run(run, out_path=archive)
+    # force a distinct mtime: gzip output size may be identical and some
+    # filesystems have 1s timestamp granularity
+    st = os.stat(archive)
+    os.utime(archive, ns=(st.st_atime_ns, st.st_mtime_ns + 2_000_000_000))
     resolved2 = resolve_run_dir(archive, cache_dir=cache1)
     assert resolved2 != resolved
     assert os.path.exists(os.path.join(resolved2, "config.json"))

@@ -2,6 +2,9 @@
 processes, 4 virtual devices each (VERDICT r2 item 6 — previously
 ``jax.distributed.initialize`` / ``local_batch_size`` /
 ``make_array_from_process_local_data`` / run-id broadcast were dead code).
+The child runs a 4×2 data×model mesh with sequence parallelism ON, so the
+multi-host exercise also covers the grid-axis SP collectives (SURVEY.md
+§2.4 SP row) across the process boundary.
 """
 
 import json
