@@ -26,3 +26,20 @@ def sanitized_cpu_env(n_devices: int = 1,
     if extra:
         env.update(extra)
     return env
+
+
+def compile_cache_env(repo_root: Optional[str] = None) -> Dict[str, str]:
+    """The persistent-XLA-compile-cache env trio, defined once.
+
+    Shared by tests/conftest.py, the dryrun child (__graft_entry__), and any
+    other entry point that wants warm second-order-grad compiles.  One
+    definition — a drifted copy silently gives that entry point a cold or
+    separate cache.
+    """
+    root = repo_root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return {
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(root, ".jax_compile_cache"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "2",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "-1",
+    }

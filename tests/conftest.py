@@ -18,12 +18,13 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # -grad compiles (R1/PL step variants); repeat runs and the sanitized
 # subprocess children (multihost, dryrun) reuse them.  Keyed by HLO hash,
 # so source edits invalidate exactly what they change.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_compile_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from gansformer_tpu.utils.hostenv import compile_cache_env  # noqa: E402
+
+for _k, _v in compile_cache_env().items():
+    os.environ.setdefault(_k, _v)
 
 import numpy as np
 import pytest
