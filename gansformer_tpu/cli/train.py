@@ -106,7 +106,7 @@ def config_from_args(args) -> ExperimentConfig:
                       num_processes=args.num_processes,
                       process_id=args.process_id)
     return ExperimentConfig(name=cfg.name, model=model, train=train,
-                            data=data, mesh=mesh)
+                            data=data, mesh=mesh).validate()
 
 
 def _latest_run_dir(results_dir: str):

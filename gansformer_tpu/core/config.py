@@ -231,6 +231,55 @@ class ExperimentConfig:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 
+    def validate(self) -> "ExperimentConfig":
+        """Fail fast with a clear message instead of a deep trace-time
+        assert (the reference validates nothing; SURVEY.md §5 config row
+        asks for typed configs WITH validation).  Returns self for
+        chaining."""
+        m, t = self.model, self.train
+        errs = []
+        if m.resolution < 8 or m.resolution & (m.resolution - 1):
+            errs.append(f"model.resolution must be a power of two ≥ 8, "
+                        f"got {m.resolution}")
+        if m.attention not in ("none", "simplex", "duplex"):
+            errs.append(f"model.attention must be none|simplex|duplex, "
+                        f"got {m.attention!r}")
+        if m.style_mode not in ("global", "attention"):
+            errs.append(f"model.style_mode must be global|attention, "
+                        f"got {m.style_mode!r}")
+        if m.integration not in ("add", "mul", "both"):
+            errs.append(f"model.integration must be add|mul|both, "
+                        f"got {m.integration!r}")
+        if m.attention_backend != "xla":
+            # validate() gates the TRAINING entry points; the pallas
+            # kernels are forward-only (generate/evaluate override the
+            # backend after restore, without validate).
+            errs.append(f"training requires model.attention_backend='xla' "
+                        f"(got {m.attention_backend!r}); 'pallas' is for "
+                        f"the forward-only generate/evaluate paths")
+        if m.dtype not in ("float32", "bfloat16"):
+            errs.append(f"model.dtype must be float32|bfloat16, "
+                        f"got {m.dtype!r}")
+        if m.attention != "none" and m.attn_start_res > m.attn_max_res:
+            errs.append(f"attn_start_res ({m.attn_start_res}) > "
+                        f"attn_max_res ({m.attn_max_res})")
+        if m.components < 1:
+            errs.append(f"model.components must be ≥ 1, got {m.components}")
+        if t.batch_size < 1:
+            errs.append(f"train.batch_size must be ≥ 1, got {t.batch_size}")
+        if t.pl_batch_shrink > 0 and t.batch_size % t.pl_batch_shrink:
+            errs.append(f"pl_batch_shrink ({t.pl_batch_shrink}) must divide "
+                        f"batch_size ({t.batch_size})")
+        if self.mesh.model > 1 and not m.sequence_parallel:
+            errs.append("mesh.model > 1 without model.sequence_parallel — "
+                        "the model axis would idle; set sequence_parallel "
+                        "or mesh.model=1")
+        if m.sequence_parallel and self.mesh.model <= 1:
+            errs.append("model.sequence_parallel needs mesh.model > 1")
+        if errs:
+            raise ValueError("invalid config:\n  - " + "\n  - ".join(errs))
+        return self
+
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
 

@@ -49,6 +49,7 @@ def train(cfg: ExperimentConfig, run_dir: str,
           resume: bool = False,
           total_kimg: Optional[int] = None,
           logger: Optional[RunLogger] = None) -> TrainState:
+    cfg.validate()
     env = env or make_mesh(cfg.mesh)
     # Ambient mesh for the whole run: sequence-parallel grid constraints
     # (ModelConfig.sequence_parallel) resolve bare PartitionSpecs against it.

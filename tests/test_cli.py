@@ -233,3 +233,39 @@ def test_generate_cli_grid_and_interpolation(tmp_path, micro_run_dir):
     assert interp.shape == (2 * res, 5 * res, 3)  # rows x steps tiles
     assert mix.shape == (2 * res, 3 * res, 3)     # rows x cols tiles
     assert grid.size and interp.std() > 0 and mix.std() > 0
+
+
+def test_config_validate_messages():
+    """ExperimentConfig.validate fails fast with named errors instead of
+    deep trace-time asserts (SURVEY.md §5 config row)."""
+    from gansformer_tpu.core.config import (
+        ExperimentConfig, MeshConfig, ModelConfig, TrainConfig)
+
+    ok = ExperimentConfig()
+    assert ok.validate() is ok
+
+    bad = ExperimentConfig(
+        model=ModelConfig(resolution=100, attention="quadplex",
+                          attn_start_res=64, attn_max_res=8),
+        train=TrainConfig(batch_size=9, pl_batch_shrink=2))
+    with pytest.raises(ValueError) as e:
+        bad.validate()
+    msg = str(e.value)
+    for frag in ("power of two", "quadplex", "attn_start_res",
+                 "pl_batch_shrink"):
+        assert frag in msg, msg
+
+    # pallas backend is forward-only — training configs must reject it
+    with pytest.raises(ValueError, match="forward-only"):
+        ExperimentConfig(model=ModelConfig(
+            attention_backend="pallas")).validate()
+
+    # sequence-parallel / mesh.model consistency both ways
+    with pytest.raises(ValueError, match="sequence_parallel"):
+        ExperimentConfig(mesh=MeshConfig(model=2)).validate()
+    with pytest.raises(ValueError, match="mesh.model"):
+        ExperimentConfig(model=ModelConfig(sequence_parallel=True)).validate()
+
+    # every shipped preset is valid
+    for name, preset in PRESETS.items():
+        preset.validate()

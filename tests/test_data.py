@@ -523,3 +523,67 @@ def test_download_manual_datasets_refuse():
         fetch_dataset("cityscapes", "/tmp/nope")
     with pytest.raises(SystemExit, match="ffhq-dataset"):
         fetch_dataset("ffhq", "/tmp/nope")
+
+
+def test_native_scanner_fuzz_hostile_bytes():
+    """The C++ frame scanner and proto walker must never crash, hang, or
+    over-read on corrupt/hostile input — random mutations of valid records
+    plus adversarial length fields either parse or fail cleanly (the
+    overflow-safe bounds the native layer advertises)."""
+    from gansformer_tpu import native
+    from gansformer_tpu.data import tfrecord_writer as w
+
+    if native.get_lib() is None:
+        pytest.skip("no C++ toolchain in this environment")
+
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 255, (3, 8, 8), np.uint8)
+    payload = w.encode_example_image(img)
+    import io
+
+    buf = io.BytesIO()
+    for _ in range(4):
+        w.write_record(buf, payload)
+    good = buf.getvalue()
+
+    # 200 random single/multi-byte corruptions of the valid stream
+    for trial in range(200):
+        data = bytearray(good)
+        for _ in range(rs.randint(1, 4)):
+            data[rs.randint(0, len(data))] = rs.randint(0, 256)
+        try:
+            offs, lens, consumed = native.scan_records(
+                bytes(data), verify_crc=True)
+        except ValueError:
+            continue  # clean rejection is fine
+        assert consumed <= len(data)
+        for o, l in zip(offs, lens):
+            assert 0 <= o and o + l <= len(data)  # no over-read windows
+            native.parse_example(bytes(data[o:o + l]))  # may be None
+
+    # adversarial length fields: huge u64, truncations, zero-length
+    import struct
+
+    hostile = [
+        struct.pack("<Q", 2**63) + b"\x00" * 32,
+        struct.pack("<Q", len(good) * 10) + good[8:],
+        good[: len(good) // 2],
+        struct.pack("<Q", 0) + b"\x00" * 8,
+        b"\x00" * 7,  # shorter than a header
+    ]
+    for data in hostile:
+        try:
+            offs, lens, consumed = native.scan_records(data, verify_crc=True)
+        except ValueError:
+            continue
+        assert consumed <= len(data)
+        for o, l in zip(offs, lens):
+            assert 0 <= o and o + l <= len(data)
+
+    # proto walker on random garbage payloads: None or clean error only
+    for _ in range(200):
+        blob = bytes(rs.randint(0, 256, rs.randint(0, 200), np.uint8))
+        try:
+            native.parse_example(blob)
+        except ValueError:
+            pass
