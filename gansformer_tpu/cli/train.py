@@ -56,11 +56,13 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["synthetic", "npz", "tfrecord", "folder"])
     p.add_argument("--mirror-augment", action="store_true")
     # mesh / multi-host (replaces reference --num-gpus)
-    p.add_argument("--mesh-data", type=int, default=-1,
-                   help="data-axis size; -1 = all devices")
-    p.add_argument("--mesh-model", type=int, default=1,
+    p.add_argument("--mesh-data", type=int, default=None,
+                   help="data-axis size; -1 = all devices "
+                        "(default: from --config, else -1)")
+    p.add_argument("--mesh-model", type=int, default=None,
                    help="model-axis size (sequence/context parallelism "
-                        "shards attention grids over this axis)")
+                        "shards attention grids over this axis; "
+                        "default: from --config, else 1)")
     p.add_argument("--sequence-parallel", action="store_true",
                    help="shard every attention block's H*W grid axis over "
                         "the model mesh axis (needs --mesh-model > 1)")
@@ -86,8 +88,6 @@ def config_from_args(args) -> ExperimentConfig:
                      components=args.components, resolution=args.resolution,
                      dtype=args.dtype)
     if getattr(args, "sequence_parallel", False):
-        if getattr(args, "mesh_model", 1) <= 1:
-            raise SystemExit("--sequence-parallel needs --mesh-model > 1")
         model = dataclasses.replace(model, sequence_parallel=True)
     train = override(cfg.train, batch_size=args.batch_size,
                      total_kimg=args.total_kimg, g_lr=args.g_lr,
@@ -100,11 +100,19 @@ def config_from_args(args) -> ExperimentConfig:
                     resolution=args.resolution)
     if args.mirror_augment:
         data = dataclasses.replace(data, mirror_augment=True)
-    mesh = MeshConfig(data=args.mesh_data,
-                      model=getattr(args, "mesh_model", 1),
-                      coordinator_address=args.coordinator,
-                      num_processes=args.num_processes,
-                      process_id=args.process_id)
+    # Mesh flags default to the loaded config's mesh (so `--resume` of a
+    # sequence-parallel run keeps its layout without re-passing flags);
+    # validate() enforces mesh/model consistency with one clear message.
+    mesh = MeshConfig(
+        data=args.mesh_data if args.mesh_data is not None else cfg.mesh.data,
+        model=(getattr(args, "mesh_model", None)
+               if getattr(args, "mesh_model", None) is not None
+               else cfg.mesh.model),
+        coordinator_address=args.coordinator or cfg.mesh.coordinator_address,
+        num_processes=(args.num_processes if args.num_processes is not None
+                       else cfg.mesh.num_processes),
+        process_id=(args.process_id if args.process_id is not None
+                    else cfg.mesh.process_id))
     return ExperimentConfig(name=cfg.name, model=model, train=train,
                             data=data, mesh=mesh).validate()
 

@@ -162,7 +162,12 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     tick = 0
     tick_start_nimg = cur_nimg
     tick_start_time = time.time()
-    last_metrics = {}
+    # Tick-averaged scalars (the reference's autosummary semantics): per-key
+    # running sums accumulate ON DEVICE (a handful of scalar adds per step,
+    # no host sync); the tick boundary fetches sum/count.  Keys differ
+    # between reg and plain step variants, so counts are per key.
+    acc_sum: dict = {}
+    acc_cnt: dict = {}
     snapshot_images(state, cur_nimg / 1000)
 
     # Host-side decode/shuffle runs in a background thread so the device
@@ -190,7 +195,9 @@ def _train(cfg: ExperimentConfig, run_dir: str,
 
             it += 1
             cur_nimg += t.batch_size
-            last_metrics = {**d_aux, **g_aux}
+            for k, v in {**d_aux, **g_aux}.items():
+                acc_sum[k] = v if k not in acc_sum else acc_sum[k] + v
+                acc_cnt[k] = acc_cnt.get(k, 0) + 1
 
             # --- tick boundary (the ONLY host sync) -------------------------
             if cur_nimg >= tick_start_nimg + t.kimg_per_tick * 1000 or \
@@ -199,8 +206,9 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                 now = time.time()
                 sec_per_tick = now - tick_start_time
                 imgs_done = cur_nimg - tick_start_nimg
-                fetched = {k: float(jax.device_get(v))
-                           for k, v in last_metrics.items()}
+                fetched = {k: float(jax.device_get(v)) / acc_cnt[k]
+                           for k, v in acc_sum.items()}
+                acc_sum, acc_cnt = {}, {}
                 if t.debug_nans:
                     from gansformer_tpu.utils.debug import check_finite_stats
 

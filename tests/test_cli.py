@@ -269,3 +269,29 @@ def test_config_validate_messages():
     # every shipped preset is valid
     for name, preset in PRESETS.items():
         preset.validate()
+
+
+def test_resume_inherits_mesh_layout(tmp_path):
+    """--resume of a sequence-parallel run must keep the saved mesh layout
+    without re-passing --mesh-model/--sequence-parallel (the mesh flags
+    default to the loaded config's mesh)."""
+    import dataclasses
+
+    from gansformer_tpu.core.config import MeshConfig, ModelConfig
+
+    saved = ExperimentConfig(
+        model=ModelConfig(sequence_parallel=True),
+        mesh=MeshConfig(data=4, model=2))
+    path = tmp_path / "config.json"
+    path.write_text(saved.to_json())
+
+    args = build_parser().parse_args(["--config", str(path)])
+    cfg = config_from_args(args)           # validate() runs inside
+    assert cfg.mesh.model == 2 and cfg.mesh.data == 4
+    assert cfg.model.sequence_parallel
+
+    # explicit flags still override the saved layout
+    args = build_parser().parse_args(
+        ["--config", str(path), "--mesh-model", "4", "--mesh-data", "2"])
+    cfg = config_from_args(args)
+    assert cfg.mesh.model == 4 and cfg.mesh.data == 2

@@ -561,7 +561,11 @@ def test_native_scanner_fuzz_hostile_bytes():
             assert 0 <= o and o + l <= len(data)  # no over-read windows
             native.parse_example(bytes(data[o:o + l]))  # may be None
 
-    # adversarial length fields: huge u64, truncations, zero-length
+    # adversarial length fields: huge u64, truncations, zero-length.
+    # Fuzzed under BOTH CRC modes — verify_crc=False is the mode that
+    # trusts the raw length field, so it is where an over-read would live
+    # (with verify_crc=True most corruptions die at the CRC check before
+    # the bounds assertions run).
     import struct
 
     hostile = [
@@ -571,14 +575,35 @@ def test_native_scanner_fuzz_hostile_bytes():
         struct.pack("<Q", 0) + b"\x00" * 8,
         b"\x00" * 7,  # shorter than a header
     ]
-    for data in hostile:
+    for verify in (True, False):
+        for data in hostile:
+            try:
+                offs, lens, consumed = native.scan_records(
+                    data, verify_crc=verify)
+            except ValueError:
+                continue
+            assert consumed <= len(data)
+            for o, l in zip(offs, lens):
+                assert 0 <= o and o + l <= len(data)
+
+    # random corruptions with CRC checking OFF: every returned window must
+    # still be in-bounds, and the proto walker must take any window
+    for trial in range(200):
+        data = bytearray(good)
+        for _ in range(rs.randint(1, 4)):
+            data[rs.randint(0, len(data))] = rs.randint(0, 256)
         try:
-            offs, lens, consumed = native.scan_records(data, verify_crc=True)
+            offs, lens, consumed = native.scan_records(
+                bytes(data), verify_crc=False)
         except ValueError:
             continue
         assert consumed <= len(data)
         for o, l in zip(offs, lens):
             assert 0 <= o and o + l <= len(data)
+            try:
+                native.parse_example(bytes(data[o:o + l]))
+            except ValueError:
+                pass  # clean rejection of a corrupt Example is fine
 
     # proto walker on random garbage payloads: None or clean error only
     for _ in range(200):
