@@ -202,7 +202,18 @@ class FeatureExtractor:
             lambda p, x: self.net.apply({"params": p}, preprocess(x)))
 
     def __call__(self, images: jax.Array):
-        if self.env is not None:
+        """(features, logits) for ``images``.
+
+        Single-process (or no env): unchanged — device arrays in, device
+        arrays out.  With ``process_count > 1`` (VERDICT r3 weak #3) the
+        contract is: every process calls collectively, passing either the
+        same GLOBAL sharded array (the fake sweep) or its own equally-sized
+        host-local shard (the real sweep); the return value is the GLOBAL
+        features/logits as host numpy, identical on every process.
+        """
+        if self.env is None:
+            return self._apply(self.params, images)
+        if jax.process_count() == 1:
             n, d = images.shape[0], self.env.data_size
             pad = (-n) % d
             if pad:
@@ -212,7 +223,66 @@ class FeatureExtractor:
             images = jax.device_put(images, self.env.batch())
             f, l = self._apply(self.params, images)
             return (f[:n], l[:n]) if pad else (f, l)
-        return self._apply(self.params, images)
+        return self._call_multihost(images)
+
+    def _call_multihost(self, images):
+        from jax.experimental import multihost_utils
+
+        if not getattr(self, "_mh_checked", False):
+            # Calibration resolves per-host filesystem (weights npz /
+            # torch-hub cache / network luck); running the COLLECTIVE
+            # sweep with different weights per process would produce
+            # garbage or a cross-host hang — fail with words instead.
+            flags = np.asarray(multihost_utils.process_allgather(
+                np.int32(self.calibrated)))
+            if not (flags == flags.flat[0]).all():
+                raise RuntimeError(
+                    f"Inception calibration differs across processes "
+                    f"(calibrated per process: {flags.tolist()}); "
+                    f"distribute the same weights npz to every host, e.g. "
+                    f"via GANSFORMER_TPU_INCEPTION_NPZ")
+            self._mh_checked = True
+
+        def gather(x):
+            # global sharded jax.Array → full global numpy on every host
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+        if isinstance(images, jax.Array):
+            # Fake path: a jax.Array is BY CONTRACT a global array here
+            # (sample_fn/pair_fn build them via env.put_global); pad at the
+            # logical end (an SPMD op every process executes), trim after.
+            n, d = images.shape[0], self.env.data_size
+            pad = (-n) % d
+            if pad:
+                images = jnp.concatenate(
+                    [images,
+                     jnp.zeros((pad,) + images.shape[1:], images.dtype)])
+            f, l = self._apply(self.params, images)
+            return gather(f)[:n], gather(l)[:n]
+        # Real path: host-local shard, same n_local on every process (the
+        # sweep iterates fixed-size sharded batches); pad each host block
+        # to local-row divisibility, strip the interleaved pads after.
+        images = np.asarray(images)
+        n_local = images.shape[0]
+        rows = self.env.local_data_rows
+        pad = (-n_local) % rows
+        if pad:
+            images = np.concatenate(
+                [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
+        garr = jax.make_array_from_process_local_data(
+            self.env.batch(), images)
+        f, l = self._apply(self.params, garr)
+        f, l = gather(f), gather(l)
+        if pad:
+            pc = jax.process_count()
+            per = n_local + pad
+
+            def strip(x):
+                return (x.reshape((pc, per) + x.shape[1:])[:, :n_local]
+                        .reshape((pc * n_local,) + x.shape[1:]))
+
+            f, l = strip(f), strip(l)
+        return f, l
 
     def sweep(self, image_batches, max_images: int) -> Tuple[np.ndarray, np.ndarray]:
         """Iterate [-1,1]-float batches → stacked (features, logits)."""
@@ -254,51 +324,142 @@ _CAL_NPZ = os.path.join(_WEIGHTS_DIR, "inception-imagenet.npz")
 _FETCH_OUTCOME = os.path.join(_WEIGHTS_DIR, "inception-fetch-outcome.json")
 
 
-def try_fetch_calibrated(timeout: float = 240.0) -> Optional[str]:
-    """One-shot attempt to obtain calibrated ImageNet Inception weights via
-    the keras download path (VERDICT r2 item 2), with the outcome recorded
-    to ``.weights/inception-fetch-outcome.json`` either way.
+_FAILED_PROBES: dict = {}   # {source path: mtime} of failed conversions
 
-    Runs the converter in a subprocess so a hung download can't stall the
-    caller; the recorded failure marker prevents re-attempting (and
-    re-paying the network timeout) on every later metric run."""
-    import json
+
+def _npz_loads(path: str) -> bool:
+    """A truncated npz from a killed converter must never be trusted."""
+    try:
+        with np.load(path) as z:
+            return len(z.files) > 0
+    except Exception:
+        return False
+
+
+def _local_checkpoint_candidates():
+    """(kind, path) pairs of already-on-disk Inception checkpoints the
+    converter can consume WITHOUT network access (VERDICT r3 item 5):
+    an explicit env override, the torchvision/torch-hub download cache
+    (inception_v3_google-*.pth / pytorch-fid's pt_inception-*.pth), and
+    the keras download cache."""
+    cands = []
+    src = os.environ.get("GANSFORMER_TPU_INCEPTION_SRC")
+    if src and os.path.exists(src):
+        kind = "torch" if src.endswith((".pt", ".pth")) else "keras"
+        cands.append((kind, src))
+    home = os.path.expanduser("~")
+    torch_home = os.environ.get(
+        "TORCH_HOME", os.path.join(home, ".cache", "torch"))
+    hub_ckpts = os.path.join(torch_home, "hub", "checkpoints")
+    if os.path.isdir(hub_ckpts):
+        for fn in sorted(os.listdir(hub_ckpts)):
+            if "inception" in fn.lower() and fn.endswith((".pt", ".pth")):
+                cands.append(("torch", os.path.join(hub_ckpts, fn)))
+    keras_h5 = os.path.join(
+        home, ".keras", "models",
+        "inception_v3_weights_tf_dim_ordering_tf_kernels.h5")
+    if os.path.exists(keras_h5):
+        cands.append(("keras", keras_h5))
+    return cands
+
+
+def _run_converter(args, timeout: float):
+    """convert_inception CLI in a subprocess (a hung download or a poison
+    pickle can't stall/kill the caller); returns (returncode, stderr_tail)."""
     import subprocess
     import sys
 
-    def _npz_loads(path: str) -> bool:
-        """A truncated npz from a killed converter must never be trusted."""
-        try:
-            with np.load(path) as z:
-                return len(z.files) > 0
-        except Exception:
-            return False
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "gansformer_tpu.metrics.convert_inception", *args],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(_WEIGHTS_DIR))
+        return proc.returncode, (proc.stderr or "")[-800:]
+    except subprocess.TimeoutExpired:
+        return -1, f"timed out after {timeout:.0f}s"
+    except OSError as e:
+        return -1, f"spawn failed: {e}"
+
+
+def try_fetch_calibrated(timeout: float = 240.0) -> Optional[str]:
+    """Obtain calibrated ImageNet Inception weights without user action:
+    probe local checkpoint caches first (torchvision/keras/env override —
+    airgapped machines often have one), then a one-shot keras download
+    attempt (VERDICT r2 item 2), with the outcome recorded to
+    ``.weights/inception-fetch-outcome.json`` either way.
+
+    A NEW local checkpoint is noticed on any call (a user may drop one in
+    later), but a candidate that already failed conversion is skipped by
+    (path, mtime) — in-process and across processes via the outcome file —
+    so a stale/corrupt cache file cannot re-cost a converter subprocess on
+    every metric tick.  Only the NETWORK attempt is one-shot."""
+    import json
+    import sys
 
     try:
         if os.path.exists(_CAL_NPZ) and _npz_loads(_CAL_NPZ):
             return _CAL_NPZ
-        if os.path.exists(_FETCH_OUTCOME):
-            return None                  # already attempted and failed
         os.makedirs(_WEIGHTS_DIR, exist_ok=True)
     except OSError:
         return None                      # read-only install: degrade quietly
-    outcome = {"attempted": True, "path": _CAL_NPZ}
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-m",
-             "gansformer_tpu.metrics.convert_inception",
-             "--keras", "imagenet", "-o", _CAL_NPZ],
-            capture_output=True, text=True, timeout=timeout,
-            cwd=os.path.dirname(_WEIGHTS_DIR))
-        outcome["returncode"] = proc.returncode
-        outcome["stderr_tail"] = (proc.stderr or "")[-800:]
-    except subprocess.TimeoutExpired:
-        outcome["returncode"] = -1
-        outcome["stderr_tail"] = f"timed out after {timeout:.0f}s"
-    except OSError as e:
-        outcome["returncode"] = -1
-        outcome["stderr_tail"] = f"spawn failed: {e}"
-    ok = outcome.get("returncode") == 0 and _npz_loads(_CAL_NPZ)
+    failed_probes = dict(_FAILED_PROBES)
+    if os.path.exists(_FETCH_OUTCOME):
+        try:
+            with open(_FETCH_OUTCOME) as f:
+                for p in json.load(f).get("local_probes", []):
+                    if p.get("returncode") != 0 and "mtime" in p:
+                        failed_probes[p["source"]] = p["mtime"]
+        except (OSError, ValueError):
+            pass
+    outcome = {"attempted": True, "path": _CAL_NPZ, "local_probes": []}
+    for kind, src in _local_checkpoint_candidates():
+        try:
+            mtime = os.path.getmtime(src)
+        except OSError:
+            continue
+        if failed_probes.get(src) == mtime:
+            continue                     # same bytes already failed once
+        rc, err = _run_converter([f"--{kind}", src, "-o", _CAL_NPZ],
+                                 timeout=timeout)
+        probe = {"kind": kind, "source": src, "returncode": rc,
+                 "mtime": mtime}
+        if rc != 0:
+            probe["stderr_tail"] = err[-300:]
+            _FAILED_PROBES[src] = mtime
+        outcome["local_probes"].append(probe)
+        if rc == 0 and _npz_loads(_CAL_NPZ):
+            outcome["result"] = "success"
+            outcome["source"] = src
+            try:
+                with open(_FETCH_OUTCOME, "w") as f:
+                    json.dump(outcome, f, indent=2)
+            except OSError:
+                pass
+            return _CAL_NPZ
+    if os.path.exists(_FETCH_OUTCOME):
+        # network attempt already failed once; persist any NEW probe
+        # failures so other processes skip them too
+        if outcome["local_probes"]:
+            try:
+                with open(_FETCH_OUTCOME) as f:
+                    prev = json.load(f)
+                prev.setdefault("local_probes", []).extend(
+                    outcome["local_probes"])
+                with open(_FETCH_OUTCOME, "w") as f:
+                    json.dump(prev, f, indent=2)
+            except (OSError, ValueError):
+                pass
+        return None
+    rc, err = _run_converter(["--keras", "imagenet", "-o", _CAL_NPZ],
+                             timeout=timeout)
+    outcome["returncode"] = rc
+    outcome["stderr_tail"] = err
+    ok = rc == 0 and _npz_loads(_CAL_NPZ)
     if not ok and os.path.exists(_CAL_NPZ):
         try:                             # drop a partial/corrupt download
             os.unlink(_CAL_NPZ)

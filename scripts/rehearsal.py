@@ -1,0 +1,182 @@
+"""Real-dataset rehearsal (VERDICT r3 item 6): drive the ENTIRE provenance
+chain the first real deployment will hit —
+
+    archive file → loopback-mirror download → TFRecord conversion
+    (reference layout + labels) → conditional training (2 ticks,
+    checkpoints, snapshots) → metric evaluation
+
+— and record it in ``<run_dir>/provenance.json`` so the run dir's history
+starts at an archive file, not an in-memory synthetic.
+
+Airgapped behavior: with no real ``cifar-10-python.tar.gz`` on disk (pass
+one via ``--archive`` when you have it), a structurally-real stand-in is
+generated — same tar layout, same pickle schema, random pixels — and the
+provenance records exactly which regime ran.  With a real archive the
+registry sha256 is verified and recorded.
+
+Usage:
+    python scripts/rehearsal.py --work /tmp/rehearsal [--archive cifar.tar.gz]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import hashlib
+import http.server
+import json
+import os
+import pickle
+import sys
+import tarfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gansformer_tpu.core.config import (  # noqa: E402
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, TrainConfig)
+from gansformer_tpu.data.download import DATASETS, sha256_file  # noqa: E402
+
+ARCHIVE_NAME = "cifar-10-python.tar.gz"
+
+
+def build_standin_archive(path: str, n_per_batch: int = 128) -> None:
+    """A structurally-real cifar-10-python.tar.gz (tar layout + pickle
+    schema of the real thing; random pixels)."""
+    rs = np.random.RandomState(0)
+    tmp = path + ".dir"
+    os.makedirs(os.path.join(tmp, "cifar-10-batches-py"), exist_ok=True)
+    for i in range(1, 6):
+        batch = {b"data": rs.randint(0, 255, (n_per_batch, 3072), np.uint8),
+                 b"labels": [int(x) for x in rs.randint(0, 10, n_per_batch)]}
+        with open(os.path.join(tmp, "cifar-10-batches-py",
+                               f"data_batch_{i}"), "wb") as f:
+            pickle.dump(batch, f)
+    with tarfile.open(path, "w:gz") as t:
+        t.add(os.path.join(tmp, "cifar-10-batches-py"),
+              arcname="cifar-10-batches-py")
+
+
+def serve_dir(directory: str):
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=directory)
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--work", required=True, help="working directory")
+    ap.add_argument("--archive", default=None,
+                    help="a real cifar-10-python.tar.gz (sha-verified); "
+                         "default: generate the stand-in")
+    ap.add_argument("--ticks", type=int, default=2)
+    ap.add_argument("--metric-images", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    work = os.path.abspath(args.work)
+    mirror = os.path.join(work, "mirror")
+    os.makedirs(mirror, exist_ok=True)
+    prov: dict = {"chain": []}
+
+    # 1. the archive file the chain starts at
+    if args.archive:
+        archive = os.path.abspath(args.archive)
+        real = sha256_file(archive) == DATASETS["cifar10"].sha256
+        prov["regime"] = ("real archive (registry sha256 verified)" if real
+                          else "archive provided but sha256 MISMATCH — "
+                               "treated as stand-in")
+        import shutil
+
+        shutil.copy(archive, os.path.join(mirror, ARCHIVE_NAME))
+    else:
+        build_standin_archive(os.path.join(mirror, ARCHIVE_NAME))
+        prov["regime"] = ("generated stand-in (airgapped: no real CIFAR "
+                          "archive on disk); same tar/pickle structure")
+    archive_path = os.path.join(mirror, ARCHIVE_NAME)
+    prov["chain"].append({
+        "stage": "archive", "path": archive_path,
+        "bytes": os.path.getsize(archive_path),
+        "sha256": sha256_file(archive_path)})
+
+    # 2-3. loopback-mirror download + TFRecord conversion (reference layout)
+    srv, base = serve_dir(mirror)
+    try:
+        from gansformer_tpu.cli.prepare_data import main as prepare
+
+        tfr_dir = os.path.join(work, "tfrecords")
+        verify = prov["regime"].startswith("real")
+        prepare(["--download", "cifar10", "--mirror-url", base,
+                 "--download-dir", os.path.join(work, "downloads"),
+                 *([] if verify else ["--download-no-verify"]),
+                 "--to", "tfrecord", "--out", tfr_dir, "--name", "cifar10"])
+    finally:
+        srv.shutdown()
+    prov["chain"].append({
+        "stage": "download+convert", "mirror": base,
+        "sha256_verified": verify,
+        "tfrecords": {fn: os.path.getsize(os.path.join(tfr_dir, fn))
+                      for fn in sorted(os.listdir(tfr_dir))}})
+
+    # 4. conditional training from the TFRecords (labels flip G/D into
+    # conditional mode end-to-end — train/loop.resolve_conditional)
+    from gansformer_tpu.train.loop import train
+
+    cfg = ExperimentConfig(
+        name="rehearsal-cifar32",
+        model=ModelConfig(resolution=32, components=4, latent_dim=32,
+                          w_dim=32, mapping_dim=32, mapping_layers=2,
+                          fmap_base=1024, fmap_max=64, attention="duplex",
+                          attn_start_res=8, attn_max_res=16,
+                          mbstd_group_size=2),
+        train=TrainConfig(batch_size=8, total_kimg=args.ticks,
+                          kimg_per_tick=1, snapshot_ticks=args.ticks,
+                          image_snapshot_ticks=1, metric_ticks=0,
+                          r1_gamma=1.0, seed=3),
+        data=DataConfig(name="cifar10", path=tfr_dir, resolution=32,
+                        source="tfrecord"),
+        mesh=MeshConfig())
+    run_dir = os.path.join(work, "run")
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "config.json"), "w") as f:
+        f.write(cfg.to_json())
+    state = train(cfg, run_dir)
+    import jax
+
+    # train() re-records the RESOLVED config (the labeled dataset switched
+    # the model conditional, which changes the param tree) — evaluation
+    # must rebuild from it, like any later generate/evaluate would.
+    with open(os.path.join(run_dir, "config.json")) as f:
+        cfg_resolved = ExperimentConfig.from_json(f.read())
+    kimg = int(jax.device_get(state.step)) / 1000
+    prov["chain"].append({
+        "stage": "train",
+        "run_dir": run_dir,
+        "kimg": kimg,
+        "conditional_label_dim": cfg_resolved.model.label_dim,
+        "artifacts": sorted(fn for fn in os.listdir(run_dir)
+                            if not fn.startswith("."))})
+
+    # 5. metric evaluation of the freshly trained checkpoint
+    from gansformer_tpu.metrics.sweep import run_metric_sweep
+    results = run_metric_sweep(
+        cfg_resolved, state, run_dir, f"fid{args.metric_images}",
+        batch_size=8, num_images=args.metric_images)
+    prov["chain"].append({
+        "stage": "evaluate",
+        "metrics": {k: float(v) for k, v in results.items()}})
+
+    prov["wall_seconds"] = round(time.time() - t0, 1)
+    with open(os.path.join(run_dir, "provenance.json"), "w") as f:
+        json.dump(prov, f, indent=2)
+    print(json.dumps(prov, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,8 +1,11 @@
 """Metric pipeline tests — closed-form Fréchet distance on synthetic
 Gaussians (SURVEY.md §4 'Implication for the TPU build')."""
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from gansformer_tpu.metrics.fid import (
     compute_activation_stats,
@@ -213,6 +216,9 @@ def test_calibrated_fetch_attempt_is_one_shot(tmp_path, monkeypatch):
     monkeypatch.setattr(inc, "_WEIGHTS_DIR", str(tmp_path))
     monkeypatch.setattr(inc, "_CAL_NPZ", str(tmp_path / "w.npz"))
     monkeypatch.setattr(inc, "_FETCH_OUTCOME", str(tmp_path / "o.json"))
+    # this test is about the one-shot NETWORK attempt; local cache probes
+    # (tested separately below) depend on the host's ~/.cache contents
+    monkeypatch.setattr(inc, "_local_checkpoint_candidates", lambda: [])
 
     calls = []
 
@@ -236,6 +242,127 @@ def test_calibrated_fetch_attempt_is_one_shot(tmp_path, monkeypatch):
     np.savez(tmp_path / "w.npz", a=np.zeros(1))
     assert inc.try_fetch_calibrated() == str(tmp_path / "w.npz")
     assert len(calls) == 1
+
+
+def _flat_from_net_params(params) -> dict:
+    """Our InceptionV3 param tree → flat {'a/b/c': np.ndarray}."""
+    flat = {}
+
+    def walk(node, prefix):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                walk(v, prefix + k + "/")
+            else:
+                flat[prefix + k] = np.asarray(v)
+
+    walk(params, "")
+    return flat
+
+
+def synthetic_torch_checkpoint(seed: int = 0) -> dict:
+    """A torchvision-named Inception state_dict with our net's shapes and
+    random values — the airgapped stand-in for pt_inception-2015-12-05."""
+    from gansformer_tpu.metrics.convert_inception import (
+        _TORCH_CONV_RENAME, ordered_convbn_paths)
+    from gansformer_tpu.metrics.inception import FeatureExtractor
+
+    flat = _flat_from_net_params(FeatureExtractor(None, seed=seed).params)
+    inv = {v: k for k, v in _TORCH_CONV_RENAME.items()}
+    sd = {}
+    for path in ordered_convbn_paths():
+        block, _, branch = path.partition("/")
+        mod = (inv[block] if not branch else
+               f"{block}." + ("branch_pool" if branch == "bpool"
+                              else branch.replace("b", "branch", 1)))
+        sd[f"{mod}.conv.weight"] = flat[f"{path}/conv/kernel"].transpose(
+            3, 2, 0, 1)
+        sd[f"{mod}.bn.weight"] = np.ones_like(flat[f"{path}/beta"])
+        sd[f"{mod}.bn.bias"] = flat[f"{path}/beta"]
+        sd[f"{mod}.bn.running_mean"] = flat[f"{path}/mean"]
+        sd[f"{mod}.bn.running_var"] = flat[f"{path}/var"]
+        sd[f"{mod}.bn.num_batches_tracked"] = np.zeros((), np.int64)
+    sd["fc.weight"] = flat["fc/kernel"].T
+    sd["fc.bias"] = flat["fc/bias"]
+    return sd
+
+
+def test_local_torch_cache_probe_converts_and_calibrates(tmp_path,
+                                                         monkeypatch):
+    """try_fetch_calibrated (VERDICT r3 item 5): a torch checkpoint already
+    sitting in the torch-hub download cache is found, converted through the
+    REAL converter subprocess, and yields a calibrated extractor — no
+    network involved."""
+    torch = pytest.importorskip("torch")
+
+    from gansformer_tpu.metrics import inception as inc
+
+    hub = tmp_path / "torch_home" / "hub" / "checkpoints"
+    hub.mkdir(parents=True)
+    torch.save(synthetic_torch_checkpoint(),
+               str(hub / "inception_v3_google-test.pth"))
+
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path / "torch_home"))
+    monkeypatch.setattr(inc, "_WEIGHTS_DIR", str(tmp_path / "w"))
+    monkeypatch.setattr(inc, "_CAL_NPZ", str(tmp_path / "w" / "cal.npz"))
+    monkeypatch.setattr(inc, "_FETCH_OUTCOME",
+                        str(tmp_path / "w" / "outcome.json"))
+
+    got = inc.try_fetch_calibrated(timeout=180.0)
+    assert got == str(tmp_path / "w" / "cal.npz"), got
+    import json
+    outcome = json.load(open(tmp_path / "w" / "outcome.json"))
+    assert outcome["result"] == "success"
+    assert outcome["local_probes"][0]["kind"] == "torch"
+
+    ext = inc.FeatureExtractor(inc.load_params_npz(got))
+    assert ext.calibrated
+    # converted weights are numerically usable end to end
+    x = np.random.RandomState(3).rand(2, 64, 64, 3).astype(np.float32) * 2 - 1
+    f, l = ext(x)
+    assert np.isfinite(np.asarray(f)).all() and np.asarray(f).shape == (2, 2048)
+
+
+def test_failed_local_probe_is_memoized(tmp_path, monkeypatch):
+    """A corrupt checkpoint in the cache must cost ONE converter attempt,
+    not one per metric tick (code-review r4): failed probes are skipped by
+    (path, mtime) until the file changes."""
+    from gansformer_tpu.metrics import inception as inc
+
+    hub = tmp_path / "torch_home" / "hub" / "checkpoints"
+    hub.mkdir(parents=True)
+    bad = hub / "inception_corrupt.pth"
+    bad.write_bytes(b"not a checkpoint")
+
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path / "torch_home"))
+    monkeypatch.setattr(inc, "_WEIGHTS_DIR", str(tmp_path / "w"))
+    monkeypatch.setattr(inc, "_CAL_NPZ", str(tmp_path / "w" / "cal.npz"))
+    monkeypatch.setattr(inc, "_FETCH_OUTCOME",
+                        str(tmp_path / "w" / "outcome.json"))
+    monkeypatch.setattr(inc, "_FAILED_PROBES", {})
+
+    calls = []
+
+    def fake_converter(args, timeout):
+        calls.append(list(args))
+        return 1, "conversion failed"
+
+    monkeypatch.setattr(inc, "_run_converter", fake_converter)
+    assert inc.try_fetch_calibrated() is None
+    n_first = len(calls)
+    assert n_first >= 2          # the bad probe + the network attempt
+    assert inc.try_fetch_calibrated() is None
+    assert len(calls) == n_first       # probe memoized, network one-shot
+
+    # cross-process memo: a fresh in-process dict still skips via the file
+    monkeypatch.setattr(inc, "_FAILED_PROBES", {})
+    assert inc.try_fetch_calibrated() is None
+    assert len(calls) == n_first
+
+    # a CHANGED file is probed again
+    bad.write_bytes(b"different bytes")
+    os.utime(bad, (1e9, 2e9))
+    assert inc.try_fetch_calibrated() is None
+    assert len(calls) == n_first + 1
 
 
 def test_eval_mesh_falls_back_when_run_mesh_too_big():

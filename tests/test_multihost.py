@@ -36,7 +36,12 @@ def test_two_process_sharded_train_step(tmp_path):
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, cwd=os.path.dirname(os.path.dirname(child)))
         for pid in (0, 1)]
-    outs = [p.communicate(timeout=540) for p in procs]
+    try:
+        outs = [p.communicate(timeout=1500) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:      # never leak gloo-connected children
+            p.kill()
+        raise
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"child failed:\n{out}\n{err[-3000:]}"
 
@@ -49,3 +54,20 @@ def test_two_process_sharded_train_step(tmp_path):
     assert r0["rid"] == r1["rid"] == 42         # broadcast reached p1
     assert r0["cks"] == pytest.approx(r1["cks"], rel=1e-6)  # same update
     assert r0["loss_d"] == pytest.approx(r1["loss_d"], rel=1e-5)
+
+    # full tick loop (VERDICT r3 item 3): params stayed in lockstep over
+    # 2 ticks incl. the checkpoint barrier and image snapshot...
+    assert r0["loop_cks"] == pytest.approx(r1["loop_cks"], rel=1e-6)
+    run_files = set(r0["run_dir_files"])
+    assert "checkpoints" in run_files
+    assert any(fn.startswith("fakes") and fn.endswith(".png")
+               for fn in run_files), run_files
+    assert "stats.jsonl" in run_files
+    # ...and the sharded metric sweep produced IDENTICAL values on both
+    # processes (each host swept a disjoint real shard; features merged
+    # globally).
+    assert set(r0["metrics"]) == set(r1["metrics"])
+    assert any(k.startswith("fid32") for k in r0["metrics"])
+    assert any(k.startswith("ppl32") for k in r0["metrics"])
+    for k, v in r0["metrics"].items():
+        assert v == pytest.approx(r1["metrics"][k], rel=1e-4), k
