@@ -39,11 +39,16 @@ def main(argv=None) -> None:
     state = ckpt.restore(os.path.join(args.run_dir, "checkpoints"), template)
     if args.attention_backend:
         # Forward-only sweep may use the fused pallas kernels; the template
-        # above already initialized on xla (identical param tree).
+        # above already initialized on xla (identical param tree).  On a
+        # TPU, resolve_backend first smoke-compiles the kernels natively
+        # and falls back to xla (with the reason) if Mosaic lowering fails.
         import dataclasses
 
+        from gansformer_tpu.ops.pallas_attention import resolve_backend
+
         cfg = dataclasses.replace(cfg, model=dataclasses.replace(
-            cfg.model, attention_backend=args.attention_backend))
+            cfg.model,
+            attention_backend=resolve_backend(args.attention_backend)))
     from gansformer_tpu.metrics.sweep import run_metric_sweep
 
     results = run_metric_sweep(

@@ -60,11 +60,16 @@ def main(argv=None) -> None:
     if args.attention_backend:
         import dataclasses
 
+        from gansformer_tpu.ops.pallas_attention import resolve_backend
+
         if args.save_attention and args.attention_backend != "xla":
             raise SystemExit(
                 "--save-attention needs the xla backend (pallas sows no maps)")
+        # On TPU: native smoke-compile of the kernels first; fall back to
+        # xla with the printed reason if Mosaic lowering fails (ADVICE r3).
         cfg = dataclasses.replace(cfg, model=dataclasses.replace(
-            cfg.model, attention_backend=args.attention_backend))
+            cfg.model,
+            attention_backend=resolve_backend(args.attention_backend)))
     fns = make_train_steps(cfg, batch_size=args.batch_size)
 
     dataset = None

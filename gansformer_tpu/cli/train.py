@@ -63,9 +63,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model-axis size (sequence/context parallelism "
                         "shards attention grids over this axis; "
                         "default: from --config, else 1)")
-    p.add_argument("--sequence-parallel", action="store_true",
+    # Tri-state (ADVICE r3): default None inherits the loaded config — a
+    # resumed sequence-parallel run keeps its layout, and --no-sequence-
+    # parallel can turn it OFF (symmetric with the --mesh-model override).
+    p.add_argument("--sequence-parallel", action="store_const", const=True,
+                   dest="sequence_parallel", default=None,
                    help="shard every attention block's H*W grid axis over "
                         "the model mesh axis (needs --mesh-model > 1)")
+    p.add_argument("--no-sequence-parallel", action="store_const", const=False,
+                   dest="sequence_parallel",
+                   help="disable sequence parallelism (overrides a loaded "
+                        "config that enabled it)")
     p.add_argument("--coordinator", default=None,
                    help="host:port for jax.distributed.initialize")
     p.add_argument("--num-processes", type=int, default=None)
@@ -87,8 +95,9 @@ def config_from_args(args) -> ExperimentConfig:
     model = override(cfg.model, attention=args.attention,
                      components=args.components, resolution=args.resolution,
                      dtype=args.dtype)
-    if getattr(args, "sequence_parallel", False):
-        model = dataclasses.replace(model, sequence_parallel=True)
+    sp = getattr(args, "sequence_parallel", None)
+    if sp is not None:            # tri-state: None inherits the config
+        model = dataclasses.replace(model, sequence_parallel=sp)
     train = override(cfg.train, batch_size=args.batch_size,
                      total_kimg=args.total_kimg, g_lr=args.g_lr,
                      d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed)

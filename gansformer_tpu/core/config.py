@@ -270,6 +270,20 @@ class ExperimentConfig:
         if t.pl_batch_shrink > 0 and t.batch_size % t.pl_batch_shrink:
             errs.append(f"pl_batch_shrink ({t.pl_batch_shrink}) must divide "
                         f"batch_size ({t.batch_size})")
+        # Divisibility failures most likely on a pod (ADVICE r3): catch them
+        # here with a clear message instead of an opaque sharding error at
+        # the first device_put / a trace-time reshape failure in mbstd.
+        if self.mesh.data > 0 and t.batch_size % self.mesh.data:
+            errs.append(f"train.batch_size ({t.batch_size}) must be "
+                        f"divisible by mesh.data ({self.mesh.data}) — each "
+                        f"data-axis row takes an equal batch shard")
+        if m.mbstd_group_size > 1 and t.batch_size % m.mbstd_group_size:
+            # minibatch_stddev would silently shrink the group; surface the
+            # mismatch instead so the trained config means what it says.
+            errs.append(
+                f"train.batch_size ({t.batch_size}) must be divisible by "
+                f"model.mbstd_group_size ({m.mbstd_group_size}) — the "
+                f"stddev layer would silently use a smaller group")
         if self.mesh.model > 1 and not m.sequence_parallel:
             errs.append("mesh.model > 1 without model.sequence_parallel — "
                         "the model axis would idle; set sequence_parallel "

@@ -96,10 +96,20 @@ def download(url: str, dest: str, sha256: Optional[str] = None,
         return dest
     os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
     part = dest + ".part"
+    meta = part + ".meta"          # ETag/Last-Modified of the .part's origin
     offset = os.path.getsize(part) if os.path.exists(part) else 0
     req = urllib.request.Request(url)
     if offset:
         req.add_header("Range", f"bytes={offset}-")
+        # Resume validation (ADVICE r3: entries without a registry sha256
+        # must not blindly append to a possibly-changed origin file): the
+        # validator recorded at first byte makes the server send a FULL 200
+        # response — which restarts the .part below — if the file changed.
+        if os.path.exists(meta):
+            with open(meta) as f:
+                validator = f.read().strip()
+            if validator:
+                req.add_header("If-Range", validator)
     try:
         resp = urllib.request.urlopen(req, timeout=timeout)
     except urllib.error.HTTPError as e:
@@ -109,7 +119,11 @@ def download(url: str, dest: str, sha256: Optional[str] = None,
         raise
     mode = "ab" if offset and resp.status == 206 else "wb"
     if mode == "wb":
-        offset = 0  # server ignored the range; start over
+        offset = 0  # server ignored the range (or file changed); start over
+        validator = (resp.headers.get("ETag")
+                     or resp.headers.get("Last-Modified") or "")
+        with open(meta, "w") as f:
+            f.write(validator)
     total = resp.headers.get("Content-Length")
     total = (int(total) + offset) if total is not None else None
     with resp, open(part, mode) as f:
@@ -121,6 +135,12 @@ def download(url: str, dest: str, sha256: Optional[str] = None,
             offset += len(b)
             if progress:
                 progress(offset, total)
+    # Completeness: a connection dropped mid-stream must not pass as a
+    # finished file just because no sha256 is registered for this entry.
+    if total is not None and offset != total:
+        raise IOError(
+            f"{url}: connection closed at {offset}/{total} bytes; "
+            f"partial kept at {part} — re-run to resume")
     if sha256:
         got = sha256_file(part)
         if got != sha256:
@@ -128,6 +148,8 @@ def download(url: str, dest: str, sha256: Optional[str] = None,
             raise IOError(f"sha256 mismatch for {url}: got {got}, "
                           f"want {sha256} (partial discarded)")
     os.replace(part, dest)
+    if os.path.exists(meta):
+        os.remove(meta)
     return dest
 
 

@@ -68,4 +68,9 @@ def run_metric_sweep(cfg: ExperimentConfig, state, run_dir: str,
     sample_fn, pair_fn = make_metric_samplers(
         fns, state, cfg, env, dataset,
         truncation_psi=truncation_psi, seed=seed)
-    return group.run(sample_fn, dataset, pair_fn=pair_fn)
+    # Ambient mesh for the sweep (ADVICE r3): without it the sequence-
+    # parallel grid constraints in BipartiteAttention._constrain see an
+    # empty abstract mesh and silently no-op — the saved model-axis layout
+    # would idle during eval while the docstring promises it is honored.
+    with env.activate():
+        return group.run(sample_fn, dataset, pair_fn=pair_fn)

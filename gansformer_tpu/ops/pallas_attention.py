@@ -23,7 +23,11 @@ sweeps (``ModelConfig.attention_backend = 'pallas'``); the training path
 stays on the jnp composite (``ops.attention.multihead_attention``) because
 R1/path-length need second-order autodiff, which a ``custom_vjp`` around an
 opaque kernel would break (SURVEY.md §7.3 item 1).  Tests run the kernels in
-interpret mode on CPU against the jnp oracle; on TPU they compile natively.
+interpret mode on CPU against the jnp oracle; on TPU, native Mosaic lowering
+is where interpret-mode coverage can diverge (the (L,1) fp32 scratch shapes,
+``@pl.when`` accumulation), so first use on a TPU runs ``tpu_smoke_check``
+— a tiny native compile-and-compare against the jnp oracle — and the CLIs
+fall back to the xla backend with a warning if it fails (ADVICE r3).
 """
 
 from __future__ import annotations
@@ -225,3 +229,73 @@ def multihead_attention_pallas(
     return (of.reshape(n, num_heads, lq, dvh)
             .transpose(0, 2, 1, 3)
             .reshape(n, lq, dv))
+
+
+# --------------------------------------------------------------------------
+# First-use native-TPU verification gate (ADVICE r3)
+# --------------------------------------------------------------------------
+
+_TPU_SMOKE: dict = {}   # memo: {'ok': bool, 'detail': str}
+
+
+def tpu_smoke_check(atol: float = 1e-2) -> tuple:
+    """Compile both kernels NATIVELY on the ambient TPU at tiny shapes and
+    compare against the jnp oracle.  Returns ``(ok, detail)``; memoized so
+    the cost (two small compiles) is paid once per process.
+
+    Exercises both directions, multi-head folding, and the blockwise path
+    with a non-divisible n (padding + masked flash recurrence) — exactly the
+    constructs where Mosaic lowering could diverge from interpret mode.
+    """
+    if "ok" in _TPU_SMOKE:
+        return _TPU_SMOKE["ok"], _TPU_SMOKE["detail"]
+    import numpy as np
+
+    from gansformer_tpu.ops.attention import multihead_attention
+
+    try:
+        rng = np.random.RandomState(0)
+        grid = jnp.asarray(rng.randn(2, 60, 32), jnp.float32)  # n=60: pad path
+        lat = jnp.asarray(rng.randn(2, 8, 32), jnp.float32)
+        latv = jnp.asarray(rng.randn(2, 8, 32), jnp.float32)
+        gridv = jnp.asarray(rng.randn(2, 60, 32), jnp.float32)
+        # X←Y (softmax over tiny L) and Y←X (blockwise online softmax over n,
+        # 4 blocks of 16 + masking).
+        ref_xy, _ = multihead_attention(grid, lat, latv, 2)
+        got_xy = multihead_attention_pallas(grid, lat, latv, 2,
+                                            interpret=False)
+        ref_yx, _ = multihead_attention(lat, grid, gridv, 2)
+        got_yx = multihead_attention_pallas(lat, grid, gridv, 2, block_n=16,
+                                            interpret=False)
+        d_xy = float(jnp.max(jnp.abs(got_xy - ref_xy)))
+        d_yx = float(jnp.max(jnp.abs(got_yx - ref_yx)))
+        ok = d_xy < atol and d_yx < atol
+        detail = (f"max_abs_diff grid_to_latent={d_xy:.2e} "
+                  f"latent_to_grid={d_yx:.2e} (atol {atol:g})")
+    except Exception as e:  # Mosaic compile failures surface as many types
+        ok = False
+        detail = f"native compile/run failed: {type(e).__name__}: {e}"[:400]
+    _TPU_SMOKE.update(ok=ok, detail=detail)
+    return ok, detail
+
+
+def resolve_backend(requested: str) -> str:
+    """'pallas' → 'pallas' only if safe on this backend, else 'xla'.
+
+    On CPU/GPU the pallas path runs in interpret mode (oracle-tested in CI);
+    on TPU the first resolution runs the native smoke check and falls back
+    to xla — with the reason printed — rather than advertising a kernel that
+    never compiled on the device class it exists for.
+    """
+    if requested != "pallas":
+        return requested
+    if jax.default_backend() != "tpu":
+        return "pallas"
+    ok, detail = tpu_smoke_check()
+    if ok:
+        return "pallas"
+    import sys
+
+    print(f"[pallas] native TPU smoke check FAILED ({detail}); "
+          f"falling back to the xla attention backend", file=sys.stderr)
+    return "xla"

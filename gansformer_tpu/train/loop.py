@@ -82,6 +82,14 @@ def _train(cfg: ExperimentConfig, run_dir: str,
             f.write(cfg.to_json())
 
     n_chips = env.mesh.size
+    # validate() covers explicit mesh.data; with the default data=-1 the
+    # axis size is the device count, known only once the mesh is built —
+    # check here so a pod run fails with words, not a sharding traceback.
+    if t.batch_size % env.data_size:
+        raise ValueError(
+            f"train.batch_size ({t.batch_size}) is not divisible by the "
+            f"resolved data-axis size ({env.data_size}); pick a batch that "
+            f"splits evenly across the data mesh axis")
     log.write(f"mesh: {dict(zip(env.mesh.axis_names, env.mesh.devices.shape))} "
               f"({n_chips} devices, {jax.process_count()} processes)")
     log.write(f"config: {cfg.name}  resolution {cfg.model.resolution}  "
@@ -175,9 +183,11 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     # Constructed HERE, directly inside the try, so the producer thread can
     # never leak if anything earlier raises.
     batches = PrefetchIterator(batch_iter, depth=cfg.data.prefetch)
-    # jax.profiler trace of tick 1 (SURVEY.md §5 tracing row): tick 0 pays
-    # the compiles, tick 1 is steady state — that's the window worth seeing
-    # in TensorBoard's profile plugin.
+    # jax.profiler trace (SURVEY.md §5 tracing row): the trace runs between
+    # the first and second tick boundaries, i.e. it captures the SECOND tick
+    # window — the one the stats log labels ``Progress/tick: 1``.  The first
+    # window pays the compiles; the traced one is steady state, which is the
+    # window worth seeing in TensorBoard's profile plugin.
     profiling = False
     try:
         while cur_nimg < total_kimg * 1000:
@@ -231,11 +241,13 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                 if t.profile_dir and tick == 1 and not profiling:
                     jax.profiler.start_trace(t.profile_dir)
                     profiling = True
-                    log.write(f"profiler: tracing tick 1 → {t.profile_dir}")
+                    log.write(f"profiler: tracing the steady-state window "
+                              f"logged as Progress/tick=1 → {t.profile_dir}")
                 elif profiling:
                     jax.profiler.stop_trace()
                     profiling = False
-                    log.write("profiler: trace complete")
+                    log.write("profiler: trace complete (window: the tick "
+                              "whose stats line above says Progress/tick=1)")
 
                 if t.image_snapshot_ticks and tick % t.image_snapshot_ticks == 0:
                     snapshot_images(state, cur_nimg / 1000)

@@ -516,6 +516,47 @@ def test_prepare_data_download_cifar(tmp_path):
         srv.shutdown()
 
 
+def test_download_truncated_stream_is_not_complete(tmp_path):
+    """A connection dropped mid-stream must raise, keep the .part for
+    resume, and never rename to the final name (ADVICE r3: entries without
+    a registry sha256 relied on nothing but luck here)."""
+    import http.server
+    import threading
+
+    from gansformer_tpu.data.download import download
+
+    payload = np.random.RandomState(2).bytes(200_000)
+
+    class Truncating(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("ETag", '"v1"')
+            self.end_headers()
+            self.wfile.write(payload[:50_000])   # then drop the connection
+            self.wfile.flush()
+            self.connection.close()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Truncating)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        dest = str(tmp_path / "blob.bin")
+        with pytest.raises(Exception) as e:
+            download(f"http://127.0.0.1:{srv.server_address[1]}/blob.bin",
+                     dest)
+        # either our completeness check or httplib's IncompleteRead —
+        # both are loud; what matters is no silent half-file under `dest`
+        assert not os.path.exists(dest), e
+        assert os.path.exists(dest + ".part")
+        # the resume validator was recorded at first byte
+        assert open(dest + ".part.meta").read().strip() == '"v1"'
+    finally:
+        srv.shutdown()
+
+
 def test_download_manual_datasets_refuse():
     from gansformer_tpu.data.download import fetch_dataset
 
