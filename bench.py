@@ -64,14 +64,6 @@ import sys
 import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 200.0
-# bf16 peak TFLOP/s per chip by device_kind substring (public TPU specs;
-# the MFU denominator).  Order matters: 'v5 lite' must win over 'v5'.
-_BF16_PEAK_TFLOPS = [
-    ("v6e", 918.0), ("v6 lite", 918.0), ("v6", 918.0),
-    ("v5e", 197.0), ("v5 lite", 197.0), ("v5litepod", 197.0),
-    ("v5p", 459.0), ("v5", 459.0),
-    ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
-]
 _INNER_FLAG = "_GRAFT_BENCH_INNER"
 _SELF = os.path.abspath(__file__)
 _REPO = os.path.dirname(_SELF)
@@ -85,14 +77,6 @@ def _log(msg: str) -> None:
 
 
 _T0 = time.time()
-
-
-def _peak_tflops(device_kind: str):
-    dk = device_kind.lower()
-    for key, val in _BF16_PEAK_TFLOPS:
-        if key in dk:
-            return val
-    return None
 
 
 def _flops_of(compiled):
@@ -136,6 +120,8 @@ def _run_inner() -> None:
     from gansformer_tpu.parallel.mesh import make_mesh
     from gansformer_tpu.train.state import create_train_state
     from gansformer_tpu.train.steps import make_train_steps
+    from gansformer_tpu.utils.benchcheck import (
+        cadence_weighted, find_suspects, mfu as mfu_of, peak_tflops)
 
     n_chips = len(jax.devices())
     platform = jax.devices()[0].platform
@@ -186,7 +172,7 @@ def _run_inner() -> None:
     # Device identity evidence (VERDICT r3 item 1c): enough to answer
     # "was this really N chips of kind K?" from the artifact alone.
     dev0 = jax.devices()[0]
-    peak = _peak_tflops(dev0.device_kind) if on_tpu else None
+    peak = peak_tflops(dev0.device_kind) if on_tpu else None
     identity = {
         "device_kind": dev0.device_kind,
         "platform": platform,
@@ -204,8 +190,24 @@ def _run_inner() -> None:
         pass
 
     best = 0.0
+    best_bsz = 0            # global batch of the best phase-weighted result
     last_out: dict = {}     # last emitted JSON (for sweep_stopped annotation)
     sweep_notes: list = []  # OOM history; survives later emits
+    phase_results: dict = {}   # global batch -> (timings, flops) from measure
+
+    def emit_json(out: dict) -> None:
+        """THE artifact-emission path (stdout line + phases file + last_out)
+        — shared by the phase-weighted and fused-cycle emitters."""
+        if sweep_notes:
+            out["sweep_stopped"] = list(sweep_notes)
+        last_out.clear()
+        last_out.update(out)
+        print(json.dumps(out), flush=True)
+        try:
+            with open(_PHASES_OUT, "w") as f:
+                json.dump(out, f, indent=2)
+        except OSError:
+            pass
 
     def measure(bsz: int, emit_only_if_better: bool) -> float:
         """Compile+time the 4 lazy-reg phase variants at one global batch;
@@ -234,54 +236,22 @@ def _run_inner() -> None:
         linearity: dict = {}  # per-it time at N vs 2N iterations
 
         def weighted(vals: dict) -> float:
-            # Cadence-weighted steady-state iteration cost (SURVEY §3.1
-            # hot loop).  With only (d, g) present, reg phases are
-            # approximated by the plain ones.
-            d0, g0 = vals["d"], vals["g"]
-            dr = vals.get("d_r1", d0)
-            gp = vals.get("g_pl", g0)
-            return (d0 * (1 - 1 / t.d_reg_interval) + dr / t.d_reg_interval
-                    + g0 * (1 - 1 / t.g_reg_interval) + gp / t.g_reg_interval)
+            return cadence_weighted(vals, t.d_reg_interval, t.g_reg_interval)
 
         def per_chip_now() -> float:
             return bsz / weighted(timings) / n_chips
 
         def suspects() -> list:
             """Physics/consistency checks (VERDICT r3 item 1a): a result
-            failing any of these is flagged, never silently reported."""
-            out = []
-            if peak and all(k in flops for k in timings):
-                mfu = weighted(flops) / weighted(timings) / (peak * 1e12)
-                if mfu >= 1.0:
-                    out.append(
-                        f"mfu {mfu:.2f} >= 1.0 — implied throughput exceeds "
-                        f"{dev0.device_kind} bf16 peak ({peak} TFLOP/s); "
-                        f"the timer is not measuring the device")
-            if "d_r1" in timings and flops.get("d") and flops.get("d_r1"):
-                tr = timings["d_r1"] / timings["d"]
-                fr = flops["d_r1"] / flops["d"]
-                if abs(tr - fr) / fr > 0.35:
-                    out.append(
-                        f"t(d_r1)/t(d) = {tr:.2f} but FLOPs ratio = {fr:.2f} "
-                        f"— phase times do not scale with compute")
-            for name, (t1, t2) in linearity.items():
-                ratio = t2 / t1 if t1 > 0 else 0.0
-                if not (0.7 <= ratio <= 1.5):
-                    out.append(
-                        f"linearity({name}): per-it time at 2N iters is "
-                        f"{ratio:.2f}x the N-iter time (expect ~1.0) — "
-                        f"wall clock not proportional to work done")
-            for name, tail in fetch_s.items():
-                # An honest block_until_ready leaves only ~1 RTT of sync
-                # tail; a tail comparable to the whole timed loop means the
-                # work was still running when the clock stopped.
-                loop_total = timings[name] * iters
-                if tail > 0.3 * loop_total + 1.0:
-                    out.append(
-                        f"{name}: device_get sync tail {tail:.2f}s after a "
-                        f"{loop_total:.2f}s timed loop — block_until_ready "
-                        f"returned before the device finished (early acks)")
-            return out
+            failing any of these is flagged, never silently reported.
+            The checks are pure functions in utils/benchcheck.py, unit-
+            tested in tests/test_benchcheck.py."""
+            return find_suspects(
+                timings, flops,
+                d_reg_interval=t.d_reg_interval,
+                g_reg_interval=t.g_reg_interval,
+                peak=peak, device_kind=dev0.device_kind, iters=iters,
+                fetch_tails=fetch_s, linearity=linearity)
 
         def emit(partial: bool) -> None:
             per_chip = per_chip_now()
@@ -327,23 +297,13 @@ def _run_inner() -> None:
                     for k in timings if k in flops}
                 if not partial and all(k in flops for k in timings):
                     out["mfu"] = round(
-                        weighted(flops) / weighted(timings) / (peak * 1e12),
-                        4)
+                        mfu_of(weighted(flops), weighted(timings), peak), 4)
             sus = suspects()
             if sus:
                 out["suspect"] = sus
-            if sweep_notes:
-                out["sweep_stopped"] = list(sweep_notes)
             if partial:
                 out["partial"] = "reg variants not yet measured"
-            last_out.clear()
-            last_out.update(out)
-            print(json.dumps(out), flush=True)
-            try:
-                with open(_PHASES_OUT, "w") as f:
-                    json.dump(out, f, indent=2)
-            except OSError:
-                pass
+            emit_json(out)
 
         st = state
         for name, fn, extra in phases:
@@ -389,7 +349,100 @@ def _run_inner() -> None:
                 emit(partial=True)
         state = st
         emit(partial=False)
+        phase_results[bsz] = (dict(timings), dict(flops))
         return per_chip_now()
+
+    def measure_cycle(bsz: int) -> None:
+        """Time the FUSED lazy-reg cycle (TrainStepFns.cycle — the whole
+        16-iteration hot loop as ONE program, the loop's --fused-cycle
+        mode): same per-iteration work as the phase-weighted number but
+        1 host dispatch per cycle instead of 32, so it bounds dispatch/
+        relay overhead from above.  Runs only on TPU, AFTER the sweep, at
+        the best phase-weighted batch.  Emits a better final line only if
+        it beats the phase-weighted best and passes validation.
+
+        FLOPs note: XLA cost analysis counts a ``lax.scan`` body ONCE,
+        not × trip count (verified empirically — a scanned matmul chain
+        reports 1/8 of its unrolled FLOPs), so the cycle program's own
+        cost analysis undercounts ~5×.  The cycle's true per-call FLOPs
+        are derived from the four PHASE measurements at the same batch:
+        cadence-weighted per-iteration FLOPs × cycle length."""
+        nonlocal state, best
+        b_cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, batch_size=bsz))
+        fns = make_train_steps(b_cfg, env, batch_size=bsz)
+        if fns.cycle is None:
+            return
+        k_cyc = fns.cycle_len
+        imgs_k = jax.device_put(
+            np.random.RandomState(0).randint(
+                0, 255, (k_cyc, bsz, res, res, 3), dtype=np.uint8),
+            env.batch_stack())
+        tc = time.time()
+        compiled = fns.cycle.lower(state, imgs_k, rng, 0).compile()
+        c_s = time.time() - tc
+        _, ph_flops = phase_results.get(bsz, ({}, {}))
+        fl = (cadence_weighted(ph_flops, t.d_reg_interval,
+                               t.g_reg_interval) * k_cyc
+              if all(k in ph_flops for k in ("d", "g", "d_r1", "g_pl"))
+              else None)
+        _log(f"[b{bsz}] compiled cycle{k_cyc} in {c_s:.1f}s"
+             + (f" ({fl / 1e12:.3f} TFLOP/call from phase analysis)"
+                if fl else ""))
+        st, sums = compiled(state, imgs_k, rng, 0)   # warm-up
+        jax.block_until_ready(st.step)
+        n_calls = max(2, iters // k_cyc * 2)
+        t0 = time.time()
+        for _ in range(n_calls):
+            st, sums = compiled(st, imgs_k, rng, 0)
+        jax.block_until_ready(st.step)
+        t_block = time.time()
+        float(np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(sums)[0])).ravel()[0])
+        tail = time.time() - t_block
+        state = st
+        per_call = (t_block - t0) / n_calls
+        per_chip = bsz * k_cyc / per_call / n_chips
+        _log(f"[b{bsz}] timed cycle{k_cyc}: {per_call * 1e3:.1f} ms/cycle "
+             f"= {per_chip:.1f} img/s/chip (sync tail {tail * 1e3:.0f} ms)")
+        out = {
+            "metric": metric,
+            "value": round(per_chip, 2),
+            "unit": "img/sec/chip",
+            "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+            "method": f"fused_cycle_{k_cyc}",
+            "n_chips": n_chips,
+            "platform": platform,
+            "batch_per_chip": bsz // n_chips,
+            "cycle_ms": round(per_call * 1e3, 2),
+            "fetch_sync_tail_s": {"cycle": round(tail, 3)},
+            "compile_s": {"cycle": round(c_s, 1)},
+            "device": identity,
+        }
+        sus = []
+        if fl:
+            out["cycle_gflops_per_chip"] = round(fl / 1e9, 1)
+            out["cycle_flops_source"] = \
+                "phase cost analysis x cadence (scan bodies count once)"
+            if peak:
+                m = fl / per_call / (peak * 1e12)
+                out["peak_bf16_tflops_per_chip"] = peak
+                out["mfu"] = round(m, 4)
+                if m >= 1.0:
+                    sus.append(
+                        f"mfu {m:.2f} >= 1.0 — implied throughput exceeds "
+                        f"{dev0.device_kind} bf16 peak")
+        if tail > 0.3 * per_call * n_calls + 1.0:
+            sus.append(f"cycle: device_get sync tail {tail:.2f}s after a "
+                       f"{per_call * n_calls:.2f}s timed loop — early acks")
+        if sus:
+            out["suspect"] = sus
+        if per_chip > best and not sus:
+            best = per_chip
+            emit_json(out)
+        else:
+            _log(f"cycle{k_cyc}: {per_chip:.1f} img/s/chip — not better "
+                 f"than {best:.1f} (or suspect), not emitting")
 
     def note_oom(msg: str) -> None:
         """Append (never overwrite) the OOM record in the final artifact."""
@@ -403,6 +456,7 @@ def _run_inner() -> None:
     try:
         try:
             best = measure(batch, emit_only_if_better=False)
+            best_bsz = batch
         except Exception as e:
             # OOM at the default batch: halve once instead of dying with
             # the budget spent (VERDICT r3 weak #4).
@@ -419,6 +473,7 @@ def _run_inner() -> None:
             # aborted execution — rebuild before retrying.
             state = fresh_state()
             best = measure(batch, emit_only_if_better=False)
+            best_bsz = batch
             note_oom(f"oom at default batch {oom_per_chip}/chip; "
                      f"fell back to {batch // n_chips}/chip")
 
@@ -441,8 +496,10 @@ def _run_inner() -> None:
                          f"(outer budget nearly spent)")
                     break
                 try:
-                    best = max(best, measure(per_chip_b * n_chips,
-                                             emit_only_if_better=True))
+                    r = measure(per_chip_b * n_chips,
+                                emit_only_if_better=True)
+                    if r > best:
+                        best, best_bsz = r, per_chip_b * n_chips
                 except Exception as e:
                     if not _is_oom(e):
                         raise
@@ -453,6 +510,29 @@ def _run_inner() -> None:
                     if last_out:
                         note_oom(f"oom at batch {per_chip_b}/chip")
                     state = fresh_state()   # buffers were donated & lost
+
+        # Fused-cycle mode (the loop's --fused-cycle): one dispatch per 16
+        # iterations, measured at the BEST phase-weighted batch — i.e. the
+        # exact config a --fused-cycle training run would use.  TPU only
+        # (one cycle call costs ~16 proxy iterations on CPU and would blow
+        # the 270s fallback budget); GRAFT_BENCH_CYCLE=0 skips it.  Cold
+        # over the tunnel the compile costs minutes — incremental emission
+        # keeps the phase-weighted number safe if the budget dies here.
+        if on_tpu and best_bsz and \
+                os.environ.get("GRAFT_BENCH_CYCLE", "1") != "0":
+            budget = float(os.environ.get("GRAFT_BENCH_TPU_TIMEOUT", "900"))
+            if time.time() - _T0 > budget - 180:
+                _log("cycle: skipping (outer budget nearly spent)")
+            else:
+                try:
+                    measure_cycle(best_bsz)
+                except Exception as e:
+                    if not _is_oom(e):
+                        raise
+                    note_oom(f"cycle oom at batch {best_bsz // n_chips}/chip "
+                             f"(stacked input adds "
+                             f"{cfg.train.d_reg_interval}x batch of uint8)")
+                    state = fresh_state()
     finally:
         if profile_dir:
             jax.profiler.stop_trace()

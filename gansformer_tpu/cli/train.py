@@ -45,6 +45,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d-lr", type=float)
     p.add_argument("--r1-gamma", type=float)
     p.add_argument("--seed", type=int)
+    p.add_argument("--fused-cycle", action="store_const", const=True,
+                   dest="fused_cycle", default=None,
+                   help="dispatch one jitted program per full lazy-reg "
+                        "cycle (d_reg_interval iterations) instead of two "
+                        "per iteration")
+    p.add_argument("--no-fused-cycle", action="store_const", const=False,
+                   dest="fused_cycle",
+                   help="disable the fused cycle (overrides a loaded "
+                        "config that enabled it)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans + per-tick finite checks")
     p.add_argument("--profile-dir", default=None,
@@ -101,6 +110,9 @@ def config_from_args(args) -> ExperimentConfig:
     train = override(cfg.train, batch_size=args.batch_size,
                      total_kimg=args.total_kimg, g_lr=args.g_lr,
                      d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed)
+    fc = getattr(args, "fused_cycle", None)
+    if fc is not None:                # tri-state: None inherits the config
+        train = dataclasses.replace(train, fused_cycle=fc)
     if args.debug_nans:
         train = dataclasses.replace(train, debug_nans=True)
     if args.profile_dir:

@@ -162,6 +162,15 @@ class TrainConfig:
     ema_kimg: float = 10.0
     ema_rampup: Optional[float] = None
 
+    # Fused lazy-reg cycle: dispatch ONE jitted program per d_reg_interval
+    # iterations (reg variants at their cadence inside, plain iterations
+    # in nested lax.scan) instead of 2 dispatches per iteration — 32× less
+    # host/dispatch overhead on the hot loop (train/steps.py ``cycle``).
+    # Requires d_reg_interval % g_reg_interval == 0.  Device-side input
+    # grows to d_reg_interval stacked batches (uint8: ~25 MB for the
+    # ffhq256 flagship at batch 8).
+    fused_cycle: bool = False
+
     # cadence (ticks are the reference's unit of logging/checkpointing)
     kimg_per_tick: int = 4
     snapshot_ticks: int = 10
@@ -277,6 +286,12 @@ class ExperimentConfig:
             errs.append(f"train.batch_size ({t.batch_size}) must be "
                         f"divisible by mesh.data ({self.mesh.data}) — each "
                         f"data-axis row takes an equal batch shard")
+        if t.fused_cycle and (t.g_reg_interval < 1 or t.d_reg_interval
+                              % t.g_reg_interval):
+            errs.append(
+                f"train.fused_cycle needs d_reg_interval "
+                f"({t.d_reg_interval}) to be a multiple of g_reg_interval "
+                f"({t.g_reg_interval})")
         if m.mbstd_group_size > 1 and t.batch_size % m.mbstd_group_size:
             # minibatch_stddev would silently shrink the group; surface the
             # mismatch instead so the trained config means what it says.

@@ -52,6 +52,12 @@ class MeshEnv:
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
+    def batch_stack(self) -> NamedSharding:
+        """[K, B, ...] stacked-iteration batches (the fused lazy-reg
+        cycle's input): axis 0 is the iteration index, axis 1 the batch —
+        shard the batch axis over data, replicate the stack axis."""
+        return NamedSharding(self.mesh, P(None, DATA_AXIS))
+
     def shard_batch(self, tree):
         """Device-put a host-local batch tree onto the data axis."""
         sh = self.batch()

@@ -129,6 +129,21 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                 batch_sharding, host_arr)
         return jax.device_put(host_arr, batch_sharding)
 
+    # Fused lazy-reg cycle (TrainConfig.fused_cycle): one dispatch per
+    # d_reg_interval iterations; inputs are K stacked batches sharded on
+    # axis 1 (the batch axis).
+    use_cycle = t.fused_cycle and fns.cycle is not None
+    stack_sharding = env.batch_stack()
+
+    def put_stack(host_arr: np.ndarray) -> jax.Array:
+        if multihost:
+            return jax.make_array_from_process_local_data(
+                stack_sharding, host_arr)
+        return jax.device_put(host_arr, stack_sharding)
+
+    if use_cycle:
+        log.write(f"fused cycle: {fns.cycle_len} iterations per dispatch")
+
     # --- fixed grid latents for snapshots ------------------------------------
     grid_n = min(16, t.batch_size * 2)
     grid_z = jax.random.normal(
@@ -189,25 +204,49 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     # window pays the compiles; the traced one is steady state, which is the
     # window worth seeing in TensorBoard's profile plugin.
     profiling = False
+    base_rng = jax.random.PRNGKey(t.seed + 4)
     try:
         while cur_nimg < total_kimg * 1000:
-            batch = next(batches)
-            imgs = put_batch(batch["image"])
-            label = (put_batch(batch["label"])
-                     if cfg.model.label_dim and "label" in batch else None)
-            step_rng = jax.random.fold_in(jax.random.PRNGKey(t.seed + 4), it)
+            if use_cycle and it % t.d_reg_interval == 0:
+                # One dispatch = a full lazy-reg cycle.  Per-iteration rng
+                # derivation inside matches the unfused path exactly
+                # (held to parity in tests/test_train.py).
+                k_cycle = fns.cycle_len
+                batch_list = [next(batches) for _ in range(k_cycle)]
+                imgs_k = put_stack(np.stack(
+                    [b["image"] for b in batch_list]))
+                label_k = (put_stack(np.stack(
+                    [b["label"] for b in batch_list]))
+                    if cfg.model.label_dim and "label" in batch_list[0]
+                    else None)
+                state, sums = fns.cycle(state, imgs_k, base_rng, it, label_k)
+                it += k_cycle
+                cur_nimg += t.batch_size * k_cycle
+                for k, v in sums.items():
+                    acc_sum[k] = v if k not in acc_sum else acc_sum[k] + v
+                    acc_cnt[k] = acc_cnt.get(k, 0) + fns.cycle_counts[k]
+            else:
+                batch = next(batches)
+                imgs = put_batch(batch["image"])
+                label = (put_batch(batch["label"])
+                         if cfg.model.label_dim and "label" in batch
+                         else None)
+                step_rng = jax.random.fold_in(base_rng, it)
 
-            d_fn = fns.d_step_r1 if (it % t.d_reg_interval == 0) else fns.d_step
-            state, d_aux = d_fn(state, imgs, jax.random.fold_in(step_rng, 0),
-                                label)
-            g_fn = fns.g_step_pl if (it % t.g_reg_interval == 0) else fns.g_step
-            state, g_aux = g_fn(state, jax.random.fold_in(step_rng, 1), label)
+                d_fn = (fns.d_step_r1 if (it % t.d_reg_interval == 0)
+                        else fns.d_step)
+                state, d_aux = d_fn(state, imgs,
+                                    jax.random.fold_in(step_rng, 0), label)
+                g_fn = (fns.g_step_pl if (it % t.g_reg_interval == 0)
+                        else fns.g_step)
+                state, g_aux = g_fn(state, jax.random.fold_in(step_rng, 1),
+                                    label)
 
-            it += 1
-            cur_nimg += t.batch_size
-            for k, v in {**d_aux, **g_aux}.items():
-                acc_sum[k] = v if k not in acc_sum else acc_sum[k] + v
-                acc_cnt[k] = acc_cnt.get(k, 0) + 1
+                it += 1
+                cur_nimg += t.batch_size
+                for k, v in {**d_aux, **g_aux}.items():
+                    acc_sum[k] = v if k not in acc_sum else acc_sum[k] + v
+                    acc_cnt[k] = acc_cnt.get(k, 0) + 1
 
             # --- tick boundary (the ONLY host sync) -------------------------
             if cur_nimg >= tick_start_nimg + t.kimg_per_tick * 1000 or \

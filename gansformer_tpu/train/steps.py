@@ -52,6 +52,20 @@ class TrainStepFns:
     d_step_r1: Callable[[TrainState, Any, jax.Array], Tuple[TrainState, Metrics]]
     g_step: Callable[[TrainState, jax.Array], Tuple[TrainState, Metrics]]
     g_step_pl: Callable[[TrainState, jax.Array], Tuple[TrainState, Metrics]]
+    # Fused lazy-reg cycle: ONE jitted program running ``cycle_len``
+    # full (D, G) iterations — the reg variants at their cadence, the
+    # plain iterations inside nested ``lax.scan`` so the compiled program
+    # stays ~the size of the four phase programs, not cycle_len×.  One
+    # host dispatch per cycle_len iterations: python/dispatch overhead
+    # (and, on a tunneled backend, per-call RTT exposure) drops 32×.
+    # ``None`` when d_reg_interval is not a multiple of g_reg_interval.
+    # Signature: cycle(state, imgs [K,B,H,W,C], rng, it0, labels?) →
+    # (state, aux_sums); per-key iteration counts are STATIC and live in
+    # ``cycle_counts`` (host ints — keeping them out of the jit return
+    # avoids per-dispatch device scalar traffic for trace-time constants).
+    cycle: Optional[Callable]
+    cycle_len: int
+    cycle_counts: Dict[str, int]
     # Generator sampler (params, w_avg, z, rng, truncation_psi) — pass
     # ``ema_params`` for eval (the Gs path) or ``g_params`` for debug grids.
     sample: Callable[..., jax.Array]
@@ -205,6 +219,96 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
             g_params=g_params, g_opt=g_opt, ema_params=ema_params,
             w_avg=w_avg, pl_mean=new_pl_mean), aux
 
+    # ---------------- fused lazy-reg cycle ----------------
+
+    d_reg, g_reg = t.d_reg_interval, t.g_reg_interval
+    can_cycle = g_reg >= 1 and d_reg >= g_reg and d_reg % g_reg == 0
+
+    def _cycle(state: TrainState, imgs_k, rng, it0, label_k=None):
+        """cycle_len = d_reg iterations in one program.
+
+        ``imgs_k``: [K, B, H, W, C] uint8 (K = d_reg); ``rng``: the loop's
+        base key (PRNGKey(seed+4)); ``it0``: global iteration index of the
+        first iteration (traced — resume-safe).  Per-iteration rng is
+        ``fold_in(rng, it0 + i)``, identical to the unfused loop's
+        derivation, so fused and unfused training follow the same random
+        stream (held to parity in tests/test_train.py).
+        """
+        n_blocks = d_reg // g_reg
+
+        def label_at(idx):
+            return None if label_k is None else label_k[idx]
+
+        def plain_body(st, idx):
+            r = jax.random.fold_in(rng, it0 + idx)
+            st, d_aux = _d_step(st, imgs_k[idx], jax.random.fold_in(r, 0),
+                                label_at(idx), do_r1=False)
+            st, g_aux = _g_step(st, jax.random.fold_in(r, 1), label_at(idx),
+                                do_pl=False)
+            return st, {**d_aux, **g_aux}
+
+        def scan_plain(st, idxs):
+            """(d, g) over a run of plain iterations; returns key-wise SUMS."""
+            st, auxes = jax.lax.scan(plain_body, st, idxs)
+            return st, jax.tree_util.tree_map(lambda a: a.sum(0), auxes)
+
+        sums: Dict[str, jax.Array] = {}
+
+        def add(aux: Dict[str, jax.Array], n_iters: int) -> None:
+            del n_iters   # counts are static — see cycle_counts below
+            for k, v in aux.items():
+                sums[k] = sums[k] + v if k in sums else v
+
+        # block 0 head: the full-reg pair (D+R1, G+PL), unrolled once
+        r0 = jax.random.fold_in(rng, it0)
+        st, d_aux = _d_step(state, imgs_k[0], jax.random.fold_in(r0, 0),
+                            label_at(0), do_r1=True)
+        st, g_aux = _g_step(st, jax.random.fold_in(r0, 1), label_at(0),
+                            do_pl=True)
+        add(d_aux, 1)
+        add(g_aux, 1)
+        if g_reg > 1:
+            st, psum = scan_plain(st, jnp.arange(1, g_reg))
+            add(psum, g_reg - 1)
+
+        if n_blocks > 1:
+            # blocks 1..n-1 share one structure — (D, G+PL) head + plain
+            # run — so they ride an outer scan (nested scans keep the
+            # compiled program size independent of d_reg).
+            def block_body(st, k):
+                base = k * g_reg
+                r = jax.random.fold_in(rng, it0 + base)
+                st, d_aux = _d_step(st, imgs_k[base],
+                                    jax.random.fold_in(r, 0), label_at(base),
+                                    do_r1=False)
+                st, g_aux = _g_step(st, jax.random.fold_in(r, 1),
+                                    label_at(base), do_pl=True)
+                head = {**d_aux, **g_aux}
+                if g_reg > 1:
+                    st, psum = scan_plain(st, base + jnp.arange(1, g_reg))
+                else:
+                    psum = {}
+                return st, (head, psum)
+
+            st, (heads, psums) = jax.lax.scan(
+                block_body, st, jnp.arange(1, n_blocks))
+            add(jax.tree_util.tree_map(lambda a: a.sum(0), heads),
+                n_blocks - 1)
+            if g_reg > 1:
+                add(jax.tree_util.tree_map(lambda a: a.sum(0), psums),
+                    (n_blocks - 1) * (g_reg - 1))
+        return st, sums
+
+    # Static per-key iteration counts for the cycle's aux SUMS (matching
+    # the loss functions' aux keys; the fused/unfused parity test asserts
+    # these against counts observed from the real unfused loop, so a new
+    # aux key cannot silently drift past this table).
+    cycle_counts = {
+        "Loss/D": d_reg, "Loss/scores/real": d_reg,
+        "Loss/scores/fake": d_reg, "Loss/G": d_reg,
+        "Loss/D/r1": 1, "Loss/G/pl": d_reg // g_reg,
+    } if can_cycle else {}
+
     # ---------------- samplers ----------------
 
     def _sample(params, w_avg, z, rng, truncation_psi: float, label=None):
@@ -236,6 +340,9 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
         d_step_r1=jax.jit(functools.partial(_d_step, do_r1=True), **donate_state),
         g_step=jax.jit(functools.partial(_g_step, do_pl=False), **donate_state),
         g_step_pl=jax.jit(functools.partial(_g_step, do_pl=True), **donate_state),
+        cycle=jax.jit(_cycle, **donate_state) if can_cycle else None,
+        cycle_len=d_reg if can_cycle else 0,
+        cycle_counts=cycle_counts,
         sample=sample,
         sample_train=sample,
         ppl_pairs=jax.jit(_ppl_pairs, static_argnames=("epsilon",)),
