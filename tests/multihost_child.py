@@ -91,11 +91,17 @@ def main() -> None:
     from gansformer_tpu.train.steps import make_metric_samplers
     from gansformer_tpu.utils.logging import RunLogger
 
+    # fused_cycle=True: the tick loop dispatches one jitted program per
+    # lazy-reg cycle, exercising the STACKED multi-host input path
+    # (put_stack → make_array_from_process_local_data on [K, B, ...]).
+    # d_reg=4/g_reg=2 keeps the cycle program small while still covering
+    # the nested block scan.
     loop_cfg = dataclasses.replace(
         cfg,
         train=dataclasses.replace(
             cfg.train, total_kimg=2, kimg_per_tick=1, snapshot_ticks=2,
-            image_snapshot_ticks=1, metric_ticks=0, seed=5),
+            image_snapshot_ticks=1, metric_ticks=0, seed=5,
+            d_reg_interval=4, g_reg_interval=2, fused_cycle=True),
     )
     run_dir = os.path.join(outdir, "run")
     os.makedirs(run_dir, exist_ok=True)
