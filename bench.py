@@ -44,6 +44,10 @@ emit that must police itself):
   times are the block clock (one fetch RTT is NOT amortized into them).
 * A linearity probe re-times the ``d`` phase at 2× iterations: constant
   time under doubled work (ratio ≪ 1) means acks, not execution.
+* A trace witness wraps a short ``d`` window in ``jax.profiler.trace``
+  and parses the xplane's DEVICE plane (utils/profparse.py): device busy
+  time far above the claimed wall time means the wall clock stopped
+  before the chip did.
 * Device identity (``device_kind``, device count, process count, HBM
   stats) is embedded so "was this really one chip?" is answerable from
   the artifact alone.
@@ -121,7 +125,8 @@ def _run_inner() -> None:
     from gansformer_tpu.train.state import create_train_state
     from gansformer_tpu.train.steps import make_train_steps
     from gansformer_tpu.utils.benchcheck import (
-        cadence_weighted, find_suspects, mfu as mfu_of, peak_tflops)
+        cadence_weighted, find_suspects, mfu as mfu_of, peak_tflops,
+        trace_suspect)
 
     n_chips = len(jax.devices())
     platform = jax.devices()[0].platform
@@ -234,6 +239,7 @@ def _run_inner() -> None:
         compile_s: dict = {}
         flops: dict = {}      # PER-DEVICE FLOPs per phase (see _flops_of)
         linearity: dict = {}  # per-it time at N vs 2N iterations
+        trace_check: dict = {}  # xplane device-time witness (phase 'd')
 
         def weighted(vals: dict) -> float:
             return cadence_weighted(vals, t.d_reg_interval, t.g_reg_interval)
@@ -246,12 +252,20 @@ def _run_inner() -> None:
             failing any of these is flagged, never silently reported.
             The checks are pure functions in utils/benchcheck.py, unit-
             tested in tests/test_benchcheck.py."""
-            return find_suspects(
+            out = find_suspects(
                 timings, flops,
                 d_reg_interval=t.d_reg_interval,
                 g_reg_interval=t.g_reg_interval,
                 peak=peak, device_kind=dev0.device_kind, iters=iters,
                 fetch_tails=fetch_s, linearity=linearity)
+            if trace_check.get("busy_s"):
+                ts = trace_suspect(trace_check["busy_s"],
+                                   trace_check["wall_s"],
+                                   trace_check["iters"],
+                                   timings.get("d", 0.0))
+                if ts:
+                    out.append(ts)
+            return out
 
         def emit(partial: bool) -> None:
             per_chip = per_chip_now()
@@ -287,6 +301,8 @@ def _run_inner() -> None:
                 out["vs_baseline_note"] = (
                     "cpu proxy (clevr64-simplex) — not comparable to the "
                     "ffhq256 TPU target; no ratio reported")
+            if trace_check:
+                out["device_trace"] = dict(trace_check)
             if flops:
                 out["phase_gflops_per_chip"] = {
                     k: round(v / 1e9, 1) for k, v in flops.items()}
@@ -345,6 +361,52 @@ def _run_inner() -> None:
                 linearity[name] = (timings[name], per_it_2n)
                 _log(f"[b{bsz}] linearity d: {per_it_2n * 1e3:.1f} ms/step "
                      f"at 2x iters")
+                # Device-time witness (VERDICT r3 item 1b): trace a short
+                # window; the xplane's device plane records what the chip
+                # actually executed — relay acks cannot fake it.  Skipped
+                # when GRAFT_BENCH_PROFILE already holds the tracer.
+                if not profile_dir:
+                    import shutil
+                    import tempfile
+
+                    from gansformer_tpu.utils.profparse import (
+                        device_busy_span)
+
+                    tdir = tempfile.mkdtemp(prefix="graft_bench_trace_")
+                    n_tr = min(10, iters)
+                    # The witness is an extra check, never a dependency:
+                    # any profiler failure logs and moves on.
+                    try:
+                        jax.profiler.start_trace(tdir)
+                        try:
+                            t0_tr = time.time()
+                            for _ in range(n_tr):
+                                st, _ = compiled(st, *extra)
+                            jax.block_until_ready(st.step)
+                            wall_tr = time.time() - t0_tr
+                        finally:
+                            jax.profiler.stop_trace()
+                        dev = device_busy_span(tdir)
+                        if dev:
+                            busy, span, plane = dev
+                            trace_check.update(
+                                busy_s=round(busy, 4), span_s=round(span, 4),
+                                wall_s=round(wall_tr, 4), iters=n_tr,
+                                plane=plane)
+                            _log(f"[b{bsz}] trace witness: device busy "
+                                 f"{busy * 1e3:.1f} ms over {n_tr} iters "
+                                 f"(wall {wall_tr * 1e3:.1f} ms, "
+                                 f"plane {plane})")
+                        else:
+                            _log(f"[b{bsz}] trace witness: no parseable "
+                                 f"device plane (non-fatal)")
+                    except Exception as e:
+                        if _is_oom(e):
+                            raise   # donated state is gone; recover upstream
+                        _log(f"[b{bsz}] trace witness failed (non-fatal): "
+                             f"{type(e).__name__}: {str(e)[:200]}")
+                    finally:
+                        shutil.rmtree(tdir, ignore_errors=True)
             if name == "g":
                 emit(partial=True)
         state = st

@@ -51,6 +51,24 @@ def mfu(flops_per_it: float, seconds_per_it: float,
     return flops_per_it / seconds_per_it / (peak_tflops_per_chip * 1e12)
 
 
+def trace_suspect(busy_s: float, wall_s: float, iters: int,
+                  per_it_s: float) -> Optional[str]:
+    """The xplane device-time witness check (pure; bench.py wires it).
+
+    ``busy_s`` is what the device plane says it executed during a traced
+    window the host claims lasted ``wall_s`` (and whose per-iteration
+    claim is ``per_it_s`` × ``iters``).  Device busy far above both claims
+    means the wall clock stopped before the chip did."""
+    if busy_s <= 0:
+        return None
+    claim = max(wall_s, iters * per_it_s)
+    if busy_s > 1.5 * claim + 0.1:
+        return (f"trace: device busy {busy_s:.3f}s in a window claimed to "
+                f"last {claim:.3f}s — wall clock is not covering device "
+                f"execution")
+    return None
+
+
 def find_suspects(
     timings: Dict[str, float],          # per-iteration seconds, per phase
     flops: Dict[str, float],            # per-device FLOPs, per phase
