@@ -76,3 +76,37 @@ def test_loop_fused_cycle_tick(tmp_path):
     assert os.path.isdir(os.path.join(d, "checkpoints"))
     # the log records the fused dispatch mode
     assert "fused cycle" in open(os.path.join(d, "log.txt")).read()
+
+
+def test_loop_fused_cycle_resume_realigns(tmp_path):
+    """Resuming a fused-cycle run at an iteration index that is NOT a
+    cycle boundary (1 kimg / batch 8 = 125 iters, 125 % 2 != 0) must fall
+    back to single-step dispatch until aligned, then continue fused —
+    and actually finish the second kimg."""
+    import dataclasses
+
+    import jax
+
+    from gansformer_tpu.train.loop import train
+
+    # first segment UNFUSED: 125 iterations → a cycle-misaligned resume
+    # point (a fused segment always stops on a cycle boundary)
+    cfg = micro_cfg(attention="simplex", batch=8)
+    cfg = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, total_kimg=1, kimg_per_tick=1, snapshot_ticks=1,
+        image_snapshot_ticks=0, fused_cycle=False))
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    state = train(cfg, d)
+    first = int(jax.device_get(state.step))
+    assert first >= 1000 and (first // 8) % 2 != 0, \
+        f"precondition: resume point must be cycle-misaligned, got {first}"
+
+    cfg2 = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, total_kimg=2, kimg_per_tick=1, snapshot_ticks=1,
+        image_snapshot_ticks=0, fused_cycle=True))
+    state2 = train(cfg2, d, resume=True)
+    assert int(jax.device_get(state2.step)) >= 2000
+    lines = [json.loads(l) for l in open(os.path.join(d, "stats.jsonl"))]
+    assert lines[-1]["Progress/kimg"] >= 2.0
+    assert np.isfinite(lines[-1]["Loss/G"])
