@@ -331,3 +331,50 @@ def test_fused_cycle_matches_unfused_loop():
     for lu, lf in zip(jax.tree_util.tree_leaves(jax.device_get(state_u.g_params)),
                       jax.tree_util.tree_leaves(jax.device_get(state_f.g_params))):
         assert np.max(np.abs(lu - lf)) <= 4 * lr + 1e-6
+
+
+def test_fused_cycle_conditional_labels():
+    """The fused cycle's label path: label_k is indexed with TRACED
+    iteration indices inside the scans — a conditional cycle must follow
+    the unfused conditional loop exactly (loss sums at fp noise)."""
+    cfg = micro_cfg()
+    cfg = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, label_dim=10),
+        train=dataclasses.replace(cfg.train, d_reg_interval=4,
+                                  g_reg_interval=2))
+    env = make_mesh(cfg.mesh)
+    fns = make_train_steps(cfg, env, batch_size=cfg.train.batch_size)
+    k = fns.cycle_len
+    rs = np.random.RandomState(3)
+    imgs_k = rs.randint(0, 255, (k, cfg.train.batch_size, 16, 16, 3),
+                        dtype=np.uint8)
+    label_k = np.eye(10, dtype=np.float32)[
+        rs.randint(0, 10, (k, cfg.train.batch_size))]
+    base_rng = jax.random.PRNGKey(9)
+
+    state_u = jax.device_put(create_train_state(cfg, jax.random.PRNGKey(0)),
+                             env.replicated())
+    acc = {}
+    for it in range(k):
+        step_rng = jax.random.fold_in(base_rng, it)
+        imgs = jax.device_put(imgs_k[it], env.batch())
+        lab = jax.device_put(label_k[it], env.batch())
+        d_fn = fns.d_step_r1 if it % 4 == 0 else fns.d_step
+        state_u, d_aux = d_fn(state_u, imgs, jax.random.fold_in(step_rng, 0),
+                              lab)
+        g_fn = fns.g_step_pl if it % 2 == 0 else fns.g_step
+        state_u, g_aux = g_fn(state_u, jax.random.fold_in(step_rng, 1), lab)
+        for key, v in {**d_aux, **g_aux}.items():
+            acc[key] = acc.get(key, 0.0) + float(jax.device_get(v))
+
+    state_f = jax.device_put(create_train_state(cfg, jax.random.PRNGKey(0)),
+                             env.replicated())
+    state_f, sums = fns.cycle(
+        state_f, jax.device_put(imgs_k, env.batch_stack()), base_rng, 0,
+        jax.device_put(label_k, env.batch_stack()))
+    for key in acc:
+        assert float(jax.device_get(sums[key])) == pytest.approx(
+            acc[key], rel=1e-4, abs=1e-4), key
+    assert int(jax.device_get(state_f.step)) == \
+        int(jax.device_get(state_u.step))
