@@ -1,0 +1,144 @@
+"""Component-level TPU micro-bench: the "poor man's profiler" for the tunnel.
+
+``jax.profiler`` cannot run over the axon TPU tunnel (observed r4: the
+tracer hangs AND a client killed mid-trace wedges the backend claim for
+subsequent processes — see bench.py ``run_witness``), so per-op time
+attribution comes from here instead: each major sub-program of the flagship
+ffhq256-duplex step is compiled and timed as its own jitted program, with
+XLA cost-analysis FLOPs and the chip's bf16 peak giving a per-component
+MFU.  A component whose MFU sits far below the full-step average is the
+optimization target; one far above average is already MXU-bound.
+
+Prints one JSON line per component: {name, ms, gflops, mfu, shapes}.
+
+  python scripts/bench_components.py [--iters 30] [--batch 8]
+
+Caveats: isolated-program MFU is not additive to the step MFU (XLA fuses
+across component boundaries inside the real step, and backward passes are
+timed as grad-of-component here), but the RANKING of time sinks transfers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--preset", default="ffhq256-duplex")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_compile_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gansformer_tpu.core.config import get_preset
+    from gansformer_tpu.models.discriminator import Discriminator
+    from gansformer_tpu.models.generator import Generator
+    from gansformer_tpu.ops.modulated_conv import modulated_conv2d
+    from gansformer_tpu.ops.upfirdn2d import upsample_2d
+    from gansformer_tpu.utils.benchcheck import peak_tflops
+
+    cfg = get_preset(args.preset).model
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    peak = peak_tflops(dev.device_kind) if on_tpu else None
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    b = args.batch
+    rs = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+
+    print(json.dumps({"device_kind": dev.device_kind,
+                      "platform": dev.platform, "batch": b,
+                      "preset": args.preset,
+                      "peak_bf16_tflops": peak}), flush=True)
+
+    from bench import _flops_of   # one FLOPs-extraction quirk handler, shared
+
+    def timed(name: str, fn, *xs, **extra_info):
+        """Compile fn(*xs), time it, emit one JSON line."""
+        t0 = time.time()
+        compiled = jax.jit(fn).lower(*xs).compile()
+        c_s = time.time() - t0
+        fl = _flops_of(compiled)
+        out = compiled(*xs)
+        jax.block_until_ready(out)          # warm-up
+        t0 = time.time()
+        for _ in range(args.iters):
+            out = compiled(*xs)
+        jax.block_until_ready(out)
+        ms = (time.time() - t0) / args.iters * 1e3
+        line = {"name": name, "ms": round(ms, 3), "compile_s": round(c_s, 1)}
+        if fl:
+            line["gflops"] = round(fl / 1e9, 2)
+            if peak:
+                line["mfu"] = round(fl / (ms * 1e-3) / (peak * 1e12), 4)
+        line.update(extra_info)
+        print(json.dumps(line), flush=True)
+        return out
+
+    # ---- leaf ops at each synthesis resolution ------------------------
+    for res in [r for r in (32, 64, 128, 256) if r <= cfg.resolution]:
+        c = cfg.nf(res)
+        x = jnp.asarray(rs.randn(b, res, res, c), dtype)
+        w3 = jnp.asarray(rs.randn(3, 3, c, c) * 0.05, dtype)
+        styles = jnp.asarray(rs.randn(b, c), jnp.float32)
+        timed(f"modconv3x3_{res}", lambda x, w, s: modulated_conv2d(x, w, s),
+              x, w3, styles, res=res, cin=c, cout=c)
+        timed(f"modconv3x3_up2_{res}",
+              lambda x, w, s: modulated_conv2d(x, w, s, up=2),
+              x, w3, styles, res=res, cin=c, cout=c)
+        timed(f"blur_up2_{res}", lambda x: upsample_2d(x, (1, 3, 3, 1)),
+              x, res=res, chans=c)
+
+    # ---- model-level programs ----------------------------------------
+    G, D = Generator(cfg), Discriminator(cfg)
+    z = jnp.asarray(rs.randn(b, cfg.num_ws, cfg.latent_dim), jnp.float32)
+    imgs = jnp.asarray(rs.randn(b, cfg.resolution, cfg.resolution, 3), dtype)
+    noise = {"noise": jax.random.PRNGKey(1)}
+
+    t0 = time.time()
+    g_vars = jax.jit(lambda k: G.init({"params": k, **noise}, z))(key)
+    d_vars = jax.jit(lambda k: D.init(k, imgs))(key)
+    jax.block_until_ready((g_vars, d_vars))
+    print(json.dumps({"name": "init", "s": round(time.time() - t0, 1)}),
+          flush=True)
+
+    ws = timed("mapping", lambda v, z: G.apply(v, z, method=Generator.map),
+               g_vars, z)
+    timed("synthesis_fwd",
+          lambda v, w: G.apply(v, w, rngs=noise, method=Generator.synthesize),
+          g_vars, ws)
+    timed("g_fwd", lambda v, z: G.apply(v, z, rngs=noise), g_vars, z)
+    timed("d_fwd", lambda v, x: D.apply(v, x), d_vars, imgs)
+
+    # backward passes (first-order only — the reg phases' second-order
+    # structure is covered by bench.py's d_r1/g_pl phase numbers)
+    def g_loss(v, z):
+        return jnp.mean(G.apply(v, z, rngs=noise).astype(jnp.float32) ** 2)
+
+    def d_loss(v, x):
+        return jnp.mean(D.apply(v, x).astype(jnp.float32) ** 2)
+
+    timed("g_fwd_bwd", lambda v, z: jax.grad(g_loss)(v, z), g_vars, z)
+    timed("d_fwd_bwd", lambda v, x: jax.grad(d_loss)(v, x), d_vars, imgs)
+
+
+if __name__ == "__main__":
+    main()
