@@ -149,6 +149,53 @@ def test_conv2d_resampling_shapes(rng):
     assert ops.conv2d(x, w, down=2).shape == (2, 4, 4, 6)
 
 
+def test_conv_transpose_poly_exact(rng):
+    # The polyphase decomposition must equal a SAME-padded correlation over
+    # the zero-inserted 2x grid EXACTLY (it reads the same taps, reordered).
+    from gansformer_tpu.ops.modulated_conv import _conv, _conv_transpose_poly
+
+    x = jnp.asarray(rng.randn(2, 8, 8, 3).astype(np.float32))
+    w = jnp.asarray((rng.randn(3, 3, 3, 5) * 0.3).astype(np.float32))
+    zi = jnp.zeros((2, 16, 16, 3), x.dtype).at[:, ::2, ::2, :].set(x)
+    want = _conv(zi, w, stride=1, padding="SAME")
+    got = _conv_transpose_poly(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_conv2d_up_polyphase_matches_blur_first(rng):
+    # conv2d(up=2) = transposed-conv-then-blur (polyphase); interior pixels
+    # must equal the commuted blur-first pipeline (upsample_2d then SAME
+    # conv).  Only the <=2-px border may differ (where zero padding
+    # truncates the commuted support) — that border is the reference's own
+    # transposed-conv boundary semantics.
+    from gansformer_tpu.ops.modulated_conv import _conv
+    from gansformer_tpu.ops.upfirdn2d import upsample_2d
+
+    x = jnp.asarray(rng.randn(2, 8, 8, 3).astype(np.float32))
+    w = jnp.asarray((rng.randn(3, 3, 3, 5) * 0.3).astype(np.float32))
+    blur_first = _conv(upsample_2d(x, (1, 3, 3, 1), factor=2), w,
+                       stride=1, padding="SAME")
+    got = ops.conv2d(x, w, up=2)
+    assert got.shape == blur_first.shape
+    np.testing.assert_allclose(
+        np.asarray(got)[:, 2:-2, 2:-2, :],
+        np.asarray(blur_first)[:, 2:-2, 2:-2, :], atol=1e-5, rtol=1e-5)
+
+
+def test_modulated_conv_up_second_order(rng):
+    # R1/PL need grad-of-grad THROUGH the up path (polyphase + blur).
+    x = jnp.asarray(rng.randn(1, 4, 4, 3).astype(np.float32))
+    w = jnp.asarray((rng.randn(3, 3, 3, 3) * 0.3).astype(np.float32))
+    s = jnp.asarray((rng.rand(1, 3) + 0.5).astype(np.float32))
+
+    def scalar(ss):
+        return jnp.sum(ops.modulated_conv2d(x, w, ss, up=2) ** 2)
+
+    h = jax.grad(lambda ss: jnp.sum(jax.grad(scalar)(ss) ** 2))(s)
+    assert np.isfinite(np.asarray(h)).all()
+
+
 # ----------------------------------------------------------------- attention
 
 @pytest.mark.parametrize("heads", [1, 4])
