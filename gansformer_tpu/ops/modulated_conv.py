@@ -70,17 +70,19 @@ def _conv_transpose_poly(x: jax.Array, w: jax.Array) -> jax.Array:
     co = w.shape[3]
     ks = (kh + 1) // 2                       # sub-kernel side (2 for k=3)
     # Phase sub-kernels: output pixel (2m+a, 2n+b) of the transposed conv
-    # reads x[m+dh, n+dw] with weight w[2dh+1-a, 2dw+1-b] (taps falling
-    # outside w are structural zeros).  Build [ks, ks, Ci, A, B, Co] then
-    # flatten phases into the output-channel axis.
-    w4 = jnp.zeros((ks, ks, ci, 2, 2, co), dtype=w.dtype)
-    for a in (0, 1):
-        for b in (0, 1):
-            for dh in range(ks):
-                for dw in range(ks):
-                    rh, rw = 2 * dh + 1 - a, 2 * dw + 1 - b
-                    if rh < kh and rw < kw:
-                        w4 = w4.at[dh, dw, :, a, b, :].set(w[rh, rw])
+    # reads x[m+dh, n+dw] with weight w[2dh+1-a, 2dw+1-b]; taps falling
+    # outside w are structural zeros, realized by indexing into a
+    # one-zero-row/col padded copy (one gather — keeps the per-step graph
+    # free of scatter ops).  Result [ks, ks, Ci, A, B, Co], phases
+    # flattened into the output-channel axis.
+    w_pad = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    dh = jnp.arange(ks)
+    a = jnp.arange(2)
+    rh = jnp.where(2 * dh[:, None] + 1 - a[None, :] < kh,
+                   2 * dh[:, None] + 1 - a[None, :], kh)    # [ks, A] -> pad row
+    w4 = w_pad[rh[:, None, :, None],                        # dh, a
+               rh[None, :, None, :]]         # [ks, ks, A, B, Ci, Co]
+    w4 = w4.transpose(0, 1, 4, 2, 3, 5)      # [ks, ks, Ci, A, B, Co]
     w4 = w4.reshape(ks, ks, ci, 4 * co)
     precision = (lax.Precision.HIGHEST if x.dtype == jnp.float32
                  else lax.Precision.DEFAULT)

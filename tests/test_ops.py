@@ -183,6 +183,19 @@ def test_conv2d_up_polyphase_matches_blur_first(rng):
         np.asarray(blur_first)[:, 2:-2, 2:-2, :], atol=1e-5, rtol=1e-5)
 
 
+def test_conv2d_up_polyphase_bf16(rng):
+    # The training path runs this op in bf16 on TPU; the polyphase
+    # decomposition must stay close to its fp32 value under bf16 inputs.
+    x32 = rng.randn(2, 8, 8, 3).astype(np.float32)
+    w32 = (rng.randn(3, 3, 3, 5) * 0.3).astype(np.float32)
+    y32 = ops.conv2d(jnp.asarray(x32), jnp.asarray(w32), up=2)
+    y16 = ops.conv2d(jnp.asarray(x32, jnp.bfloat16),
+                     jnp.asarray(w32, jnp.bfloat16), up=2)
+    assert y16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y16, np.float32), np.asarray(y32),
+                               atol=0.15, rtol=0.15)
+
+
 def test_modulated_conv_up_second_order(rng):
     # R1/PL need grad-of-grad THROUGH the up path (polyphase + blur).
     x = jnp.asarray(rng.randn(1, 4, 4, 3).astype(np.float32))
