@@ -73,7 +73,6 @@ BASELINE_IMG_PER_SEC_PER_CHIP = 200.0
 _INNER_FLAG = "_GRAFT_BENCH_INNER"
 _SELF = os.path.abspath(__file__)
 _REPO = os.path.dirname(_SELF)
-_CACHE_DIR = os.path.join(_REPO, ".jax_compile_cache")
 _PHASES_OUT = os.path.join(_REPO, ".bench_phases.json")
 
 
@@ -83,23 +82,6 @@ def _log(msg: str) -> None:
 
 
 _T0 = time.time()
-
-
-def _flops_of(compiled):
-    """PER-DEVICE FLOPs of the compiled program from XLA cost analysis.
-
-    Under SPMD, cost analysis runs on the partitioned per-device module —
-    verified empirically: a 4-way-sharded einsum reports total/4 — so these
-    numbers pair directly with per-chip phase times for MFU (no further
-    division by device count)."""
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        f = float(ca.get("flops", 0.0))
-        return f if f > 0 else None
-    except Exception:
-        return None
 
 
 def _is_oom(e: BaseException) -> bool:
@@ -115,10 +97,12 @@ def _run_inner() -> None:
 
     # Persistent compilation cache: the single biggest fix for the r1/r2
     # "TPU bench never finishes compiling" failure.  Must be set before the
-    # first compile.
-    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # first compile; ONE definition shared with the CLI entry points so
+    # bench and training warm-start each other's compiles.
+    sys.path.insert(0, _REPO)
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
+
+    enable_compile_cache(_REPO)
 
     import numpy as np
 
@@ -127,7 +111,8 @@ def _run_inner() -> None:
     from gansformer_tpu.train.state import create_train_state
     from gansformer_tpu.train.steps import make_train_steps
     from gansformer_tpu.utils.benchcheck import (
-        cadence_weighted, find_suspects, mfu as mfu_of, peak_tflops)
+        cadence_weighted, find_suspects, flops_of as _flops_of,
+        mfu as mfu_of, peak_tflops)
 
     n_chips = len(jax.devices())
     platform = jax.devices()[0].platform

@@ -29,10 +29,12 @@ def main(argv=None) -> None:
 
     from gansformer_tpu.core.config import ExperimentConfig
     from gansformer_tpu.train import checkpoint as ckpt
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
     from gansformer_tpu.train.state import create_train_state
     from gansformer_tpu.utils.runarchive import resolve_run_dir
 
     args.run_dir = resolve_run_dir(args.run_dir)
+    enable_compile_cache()
     with open(os.path.join(args.run_dir, "config.json")) as f:
         cfg = ExperimentConfig.from_json(f.read())
     template = create_train_state(cfg, jax.random.PRNGKey(0))

@@ -166,6 +166,9 @@ def main(argv=None) -> None:
             raise SystemExit(f"unknown arch {a!r}; choose from {ARCH_CHOICES}")
 
     from gansformer_tpu.core.config import ExperimentConfig, get_preset
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
+
+    enable_compile_cache()   # every sweep arm reuses the same compiles
 
     if args.config:
         with open(args.config) as f:

@@ -175,6 +175,9 @@ def main(argv=None) -> None:
 
     import jax
 
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
+
+    enable_compile_cache()   # warm second-order compiles across invocations
     is_main = jax.process_index() == 0
     if run_dir is None:
         desc = args.desc or f"{cfg.name}-{cfg.model.attention}-k{cfg.model.components}"

@@ -144,6 +144,62 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     if use_cycle:
         log.write(f"fused cycle: {fns.cycle_len} iterations per dispatch")
 
+    # --- implied-MFU bookkeeping (TPU only) ----------------------------------
+    # Cadence-weighted per-iteration FLOPs (XLA cost analysis, per-device
+    # under SPMD) + the chip's bf16 peak turn every tick's img/s into a
+    # ``timing/mfu`` the reader can check against physics — the same
+    # self-validation bench.py applies to its own numbers (PERF.md §1b).
+    # lower().compile() shares the persistent compile cache with the loop's
+    # own jit calls, so this costs one cache round-trip per phase, not a
+    # second compile.
+    flops_per_it = peak = None
+    if jax.devices()[0].platform == "tpu" and not use_cycle:
+        # Under --fused-cycle the phase programs are never compiled (only
+        # fns.cycle is, and cost analysis counts its scan bodies once, not
+        # × trip count — bench.py measure_cycle), so the estimate would
+        # need four compiles the loop otherwise skips; MFU then comes from
+        # the bench artifact instead.
+        try:
+            from gansformer_tpu.utils.benchcheck import (
+                cadence_weighted, flops_of, peak_tflops)
+
+            peak = peak_tflops(jax.devices()[0].device_kind)
+            if peak:
+                # Sharded abstract args matching the REAL dispatch (imgs
+                # and labels committed to the batch sharding, keys left to
+                # jit) — both so the persistent-cache entry is the one the
+                # loop's own first call hits, and so cost analysis runs on
+                # the same partitioned per-device module.
+                imgs_s = jax.ShapeDtypeStruct(
+                    (t.batch_size, cfg.model.resolution, cfg.model.resolution,
+                     cfg.model.img_channels), np.uint8,
+                    sharding=batch_sharding)
+                lbl_s = (jax.ShapeDtypeStruct(
+                    (t.batch_size, cfg.model.label_dim), np.float32,
+                    sharding=batch_sharding)
+                    if cfg.model.label_dim else None)
+                key_s = jax.ShapeDtypeStruct((2,), np.uint32)
+                ph = {}
+                for name, fn, extra in (
+                        ("d", fns.d_step, (imgs_s, key_s, lbl_s)),
+                        ("g", fns.g_step, (key_s, lbl_s)),
+                        ("d_r1", fns.d_step_r1, (imgs_s, key_s, lbl_s)),
+                        ("g_pl", fns.g_step_pl, (key_s, lbl_s))):
+                    fl = flops_of(fn.lower(state, *extra).compile())
+                    if fl:
+                        ph[name] = fl
+                if all(k in ph for k in ("d", "g", "d_r1", "g_pl")):
+                    flops_per_it = cadence_weighted(
+                        ph, t.d_reg_interval, t.g_reg_interval)
+                    log.write(
+                        f"mfu bookkeeping: {flops_per_it / 1e12:.3f} "
+                        f"TFLOP/iteration (cadence-weighted, per device), "
+                        f"peak {peak} TFLOP/s")
+        except Exception as e:   # never let bookkeeping kill training
+            log.write(f"mfu bookkeeping unavailable: "
+                      f"{type(e).__name__}: {str(e)[:200]}")
+            flops_per_it = None
+
     # --- fixed grid latents for snapshots ------------------------------------
     grid_n = min(16, t.batch_size * 2)
     grid_z = jax.random.normal(
@@ -272,6 +328,12 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                         imgs_done / max(sec_per_tick, 1e-9) / n_chips,
                     **fetched,
                 }
+                if flops_per_it and imgs_done:
+                    # sec per iteration × FLOPs per iteration vs chip peak;
+                    # >1.0 would mean the clock is lying (PERF.md §1b).
+                    sec_per_it = sec_per_tick / (imgs_done / t.batch_size)
+                    stats["timing/mfu"] = (
+                        flops_per_it / sec_per_it / (peak * 1e12))
                 log.log_tick(stats)
                 tick += 1
                 tick_start_nimg = cur_nimg

@@ -69,14 +69,14 @@ def main() -> None:
                       "preset": args.preset,
                       "peak_bf16_tflops": peak}), flush=True)
 
-    from bench import _flops_of   # one FLOPs-extraction quirk handler, shared
+    from gansformer_tpu.utils.benchcheck import flops_of
 
     def timed(name: str, fn, *xs, **extra_info):
         """Compile fn(*xs), time it, emit one JSON line."""
         t0 = time.time()
         compiled = jax.jit(fn).lower(*xs).compile()
         c_s = time.time() - t0
-        fl = _flops_of(compiled)
+        fl = flops_of(compiled)
         out = compiled(*xs)
         jax.block_until_ready(out)          # warm-up
         t0 = time.time()
