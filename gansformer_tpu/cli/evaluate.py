@@ -19,6 +19,14 @@ def main(argv=None) -> None:
                    help="override metric sample count (e.g. 1000 for smoke)")
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--truncation-psi", type=float, default=1.0)
+    p.add_argument("--psi-sweep", default=None,
+                   help="comma-separated truncation values (e.g. "
+                        "'0.5,0.7,1.0'): run the metrics once per psi and "
+                        "append the table to metric-psi-sweep.txt — the "
+                        "lineage's FID-vs-truncation evaluation practice. "
+                        "Real-image statistics are disk-cached across "
+                        "psis; the eval setup (mesh/extractor/samplers) is "
+                        "rebuilt per psi.")
     p.add_argument("--attention-backend", default=None,
                    choices=("xla", "pallas"),
                    help="override the attention compute backend for the "
@@ -26,6 +34,18 @@ def main(argv=None) -> None:
     p.add_argument("--inception-npz", default=None)
     p.add_argument("--cache-dir", default=None)
     args = p.parse_args(argv)
+
+    psis = None
+    if args.psi_sweep is not None:
+        # Parse/validate BEFORE the expensive run-dir resolution and
+        # checkpoint restore: a typo should fail in milliseconds.
+        try:
+            psis = [float(s) for s in args.psi_sweep.split(",") if s.strip()]
+        except ValueError:
+            p.error(f"--psi-sweep: not a comma-separated float list: "
+                    f"{args.psi_sweep!r}")
+        if not psis:
+            p.error("--psi-sweep: no values given")
 
     from gansformer_tpu.core.config import ExperimentConfig
     from gansformer_tpu.train import checkpoint as ckpt
@@ -53,12 +73,32 @@ def main(argv=None) -> None:
             attention_backend=resolve_backend(args.attention_backend)))
     from gansformer_tpu.metrics.sweep import run_metric_sweep
 
+    kimg = int(jax.device_get(state.step)) / 1000
+    if psis:
+        table = []
+        for psi in psis:
+            res = run_metric_sweep(
+                cfg, state, args.run_dir, args.metrics,
+                batch_size=args.batch_size, num_images=args.num_images,
+                truncation_psi=psi,
+                inception_npz=args.inception_npz, cache_dir=args.cache_dir)
+            table.append({"psi": psi, **res})
+            print(f"psi {psi:<5.2f} " + "  ".join(
+                f"{k} {v:.4f}" for k, v in res.items()))
+        path = os.path.join(args.run_dir, "metric-psi-sweep.txt")
+        with open(path, "a") as f:
+            for row in table:
+                f.write(f"kimg {kimg:<10.1f} psi {row['psi']:<5.2f} "
+                        + "  ".join(f"{k} {v:.6f}" for k, v in row.items()
+                                    if k != "psi") + "\n")
+        print(json.dumps({"kimg": kimg, "psi_sweep": table}))
+        return
+
     results = run_metric_sweep(
         cfg, state, args.run_dir, args.metrics,
         batch_size=args.batch_size, num_images=args.num_images,
         truncation_psi=args.truncation_psi,
         inception_npz=args.inception_npz, cache_dir=args.cache_dir)
-    kimg = int(jax.device_get(state.step)) / 1000
     for name, val in results.items():
         print(f"{name}: {val:.4f}")
         path = os.path.join(args.run_dir, f"metric-{name}.txt")

@@ -213,6 +213,28 @@ def test_evaluate_cli_end_to_end(tmp_path, micro_run_dir, capsys):
     assert any("fid32_uncal" in f for f in files)
 
 
+def test_evaluate_cli_psi_sweep(micro_run_dir, capsys):
+    """--psi-sweep: one metric table row per truncation value, appended to
+    metric-psi-sweep.txt (the lineage's FID-vs-truncation practice; real
+    stats are cached so extra psis only pay the fake-side sweep)."""
+    import os
+
+    from gansformer_tpu.cli.evaluate import main as evaluate
+
+    evaluate(["--run-dir", micro_run_dir, "--metrics", "fid",
+              "--num-images", "32", "--batch-size", "16",
+              "--psi-sweep", "0.5,1.0"])
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    rows = payload["psi_sweep"]
+    assert [r["psi"] for r in rows] == [0.5, 1.0]
+    assert all(np.isfinite(v) for r in rows for v in r.values())
+    sweep_txt = os.path.join(micro_run_dir, "metric-psi-sweep.txt")
+    with open(sweep_txt) as f:
+        tail = f.readlines()[-2:]          # file is append-only and the run
+    assert "psi 0.50" in tail[0]           # dir is session-shared — check
+    assert "psi 1.00" in tail[1]           # the rows THIS invocation wrote
+
+
 def test_evaluate_cli_calibrated_npz_roundtrip(tmp_path, micro_run_dir,
                                                capsys):
     """evaluate --inception-npz with a synthetically CONVERTED checkpoint
