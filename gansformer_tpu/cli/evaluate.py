@@ -46,6 +46,9 @@ def main(argv=None) -> None:
                     f"{args.psi_sweep!r}")
         if not psis:
             p.error("--psi-sweep: no values given")
+        if args.truncation_psi != 1.0:
+            p.error("--truncation-psi conflicts with --psi-sweep; put the "
+                    "value in the sweep list instead")
 
     from gansformer_tpu.core.config import ExperimentConfig
     from gansformer_tpu.train import checkpoint as ckpt

@@ -51,8 +51,8 @@ def main() -> None:
     from gansformer_tpu.core.config import get_preset
     from gansformer_tpu.models.discriminator import Discriminator
     from gansformer_tpu.models.generator import Generator
-    from gansformer_tpu.ops.modulated_conv import modulated_conv2d
-    from gansformer_tpu.ops.upfirdn2d import upsample_2d
+    from gansformer_tpu.ops.modulated_conv import _conv, modulated_conv2d
+    from gansformer_tpu.ops.upfirdn2d import downsample_2d, upsample_2d
     from gansformer_tpu.utils.benchcheck import peak_tflops
 
     cfg = get_preset(args.preset).model
@@ -104,7 +104,15 @@ def main() -> None:
         timed(f"modconv3x3_up2_{res}",
               lambda x, w, s: modulated_conv2d(x, w, s, up=2),
               x, w3, styles, res=res, cin=c, cout=c)
+        # The pre-polyphase dense-at-2H formulation, timed for the on-chip
+        # before/after comparison (PERF.md §1b''').
+        timed(f"upconv_dense_{res}",
+              lambda x, w: _conv(upsample_2d(x, (1, 3, 3, 1)), w,
+                                 stride=1, padding="SAME"),
+              x, w3, res=res, cin=c, cout=c)
         timed(f"blur_up2_{res}", lambda x: upsample_2d(x, (1, 3, 3, 1)),
+              x, res=res, chans=c)
+        timed(f"blur_down2_{res}", lambda x: downsample_2d(x, (1, 3, 3, 1)),
               x, res=res, chans=c)
 
     # ---- model-level programs ----------------------------------------
