@@ -180,7 +180,9 @@ def _run_inner() -> None:
     except Exception:
         pass
 
-    best = 0.0
+    best = 0.0              # best emitted img/s/chip (any method)
+    best_phase = 0.0        # best PHASE-WEIGHTED result (sweep tracking —
+    #                         the cycle number must not hide a better batch)
     best_bsz = 0            # global batch of the best phase-weighted result
     last_out: dict = {}     # last emitted JSON (for sweep_stopped annotation)
     sweep_notes: list = []  # OOM history; survives later emits
@@ -358,9 +360,11 @@ def _run_inner() -> None:
         16-iteration hot loop as ONE program, the loop's --fused-cycle
         mode): same per-iteration work as the phase-weighted number but
         1 host dispatch per cycle instead of 32, so it bounds dispatch/
-        relay overhead from above.  Runs only on TPU, AFTER the sweep, at
-        the best phase-weighted batch.  Emits a better final line only if
-        it beats the phase-weighted best and passes validation.
+        relay overhead from above.  TPU only; invoked via ``try_cycle``
+        BEFORE the sweep at the default batch (the tunnel-overhead
+        datapoint must not queue behind the optional sweep) and again
+        after it if the sweep finds a better batch.  Emits a better final
+        line only if it beats the emitted best and passes validation.
 
         FLOPs note: XLA cost analysis counts a ``lax.scan`` body ONCE,
         not × trip count (verified empirically — a scanned matmul chain
@@ -535,7 +539,7 @@ def _run_inner() -> None:
 
     try:
         try:
-            best = measure(batch, emit_only_if_better=False)
+            best = best_phase = measure(batch, emit_only_if_better=False)
             best_bsz = batch
         except Exception as e:
             # OOM at the default batch: halve once instead of dying with
@@ -552,17 +556,61 @@ def _run_inner() -> None:
             # The failed measure() donated the old state's buffers into the
             # aborted execution — rebuild before retrying.
             state = fresh_state()
-            best = measure(batch, emit_only_if_better=False)
+            best = best_phase = measure(batch, emit_only_if_better=False)
             best_bsz = batch
             note_oom(f"oom at default batch {oom_per_chip}/chip; "
                      f"fell back to {batch // n_chips}/chip")
+
+        cycle_on = (on_tpu and
+                    os.environ.get("GRAFT_BENCH_CYCLE", "1") != "0")
+        cycle_oom_bsz = None    # smallest global batch whose CYCLE OOMed
+        budget = float(os.environ.get("GRAFT_BENCH_TPU_TIMEOUT", "900"))
+
+        def try_cycle(bsz: int, label: str) -> None:
+            """measure_cycle as a best-effort extra: an OOM or any other
+            cycle-only failure is recorded in the artifact and must never
+            cost the remaining measurements (the cycle program is a scan
+            the four phase programs don't exercise — a lowering bug there
+            should not kill the sweep)."""
+            nonlocal state, cycle_oom_bsz
+            if cycle_oom_bsz is not None and bsz >= cycle_oom_bsz:
+                _log(f"cycle: skipping batch {bsz // n_chips}/chip "
+                     f"(>= known cycle OOM at {cycle_oom_bsz // n_chips}"
+                     f"/chip)")
+                return
+            if time.time() - _T0 > budget - 180:
+                _log(f"cycle ({label}): skipping (outer budget nearly "
+                     f"spent)")
+                return
+            try:
+                measure_cycle(bsz)
+            except Exception as e:
+                if _is_oom(e):
+                    cycle_oom_bsz = min(bsz, cycle_oom_bsz or bsz)
+                    note_oom(f"cycle oom at batch {bsz // n_chips}/chip "
+                             f"({label}; stacked input adds "
+                             f"{cfg.train.d_reg_interval}x batch of uint8)")
+                else:
+                    _log(f"cycle ({label}) failed (non-fatal): "
+                         f"{type(e).__name__}: {str(e)[:300]}")
+                    sweep_notes.append(
+                        f"cycle failed at batch {bsz // n_chips}/chip: "
+                        f"{type(e).__name__}")
+                state = fresh_state()   # buffers were donated & lost
+
+        # Fused-cycle at the default batch FIRST (before the compile-heavy
+        # sweep): one dispatch per 16 iterations is the number that shows
+        # whether per-dispatch tunnel overhead caps the phase-weighted
+        # result, and tunnel windows have died mid-sweep before (r4) — the
+        # most informative datapoint must not queue behind the optional one.
+        if cycle_on and best_bsz:
+            try_cycle(best_bsz, "pre-sweep")
 
         # Batch sweep (TPU only): larger per-chip batches usually feed the
         # MXU better; try each while the outer budget allows, emitting only
         # improvements so the final JSON line is the best measured config.
         if on_tpu:
             sweep = os.environ.get("GRAFT_BENCH_SWEEP", "16,32")
-            budget = float(os.environ.get("GRAFT_BENCH_TPU_TIMEOUT", "900"))
             for per_chip_b in [int(s) for s in sweep.split(",") if s.strip()]:
                 if per_chip_b * n_chips == batch:
                     continue
@@ -578,8 +626,9 @@ def _run_inner() -> None:
                 try:
                     r = measure(per_chip_b * n_chips,
                                 emit_only_if_better=True)
-                    if r > best:
-                        best, best_bsz = r, per_chip_b * n_chips
+                    if r > best_phase:
+                        best_phase, best_bsz = r, per_chip_b * n_chips
+                    best = max(best, r)
                 except Exception as e:
                     if not _is_oom(e):
                         raise
@@ -591,28 +640,14 @@ def _run_inner() -> None:
                         note_oom(f"oom at batch {per_chip_b}/chip")
                     state = fresh_state()   # buffers were donated & lost
 
-        # Fused-cycle mode (the loop's --fused-cycle): one dispatch per 16
-        # iterations, measured at the BEST phase-weighted batch — i.e. the
-        # exact config a --fused-cycle training run would use.  TPU only
-        # (one cycle call costs ~16 proxy iterations on CPU and would blow
-        # the 270s fallback budget); GRAFT_BENCH_CYCLE=0 skips it.  Cold
-        # over the tunnel the compile costs minutes — incremental emission
-        # keeps the phase-weighted number safe if the budget dies here.
-        if on_tpu and best_bsz and \
-                os.environ.get("GRAFT_BENCH_CYCLE", "1") != "0":
-            budget = float(os.environ.get("GRAFT_BENCH_TPU_TIMEOUT", "900"))
-            if time.time() - _T0 > budget - 180:
-                _log("cycle: skipping (outer budget nearly spent)")
-            else:
-                try:
-                    measure_cycle(best_bsz)
-                except Exception as e:
-                    if not _is_oom(e):
-                        raise
-                    note_oom(f"cycle oom at batch {best_bsz // n_chips}/chip "
-                             f"(stacked input adds "
-                             f"{cfg.train.d_reg_interval}x batch of uint8)")
-                    state = fresh_state()
+        # Re-measure the fused cycle at the sweep's winning batch when the
+        # sweep found a better config than the pre-sweep cycle already
+        # covered (cycle FLOPs derive from that batch's phase analyses).
+        # GRAFT_BENCH_CYCLE=0 skips both cycle measurements; CPU always
+        # skips (one cycle call costs ~16 proxy iterations and would blow
+        # the 270s fallback budget).
+        if cycle_on and best_bsz and best_bsz != batch:
+            try_cycle(best_bsz, "post-sweep")
 
         # Absolute last: the profiler witness (can hang over the tunnel).
         run_witness()
