@@ -82,3 +82,25 @@ def test_partial_phases_use_plain_approximation():
     # only (d, g): reg phases approximated by the plain ones
     w = cadence_weighted({"d": 2.0, "g": 3.0}, 16, 4)
     assert w == pytest.approx(5.0)
+
+
+def test_flops_of_compiled_and_garbage():
+    # The shared cost-analysis extractor (bench.py, bench_components.py,
+    # and the loop's MFU bookkeeping all route through it).
+    import jax
+    import jax.numpy as jnp
+
+    from gansformer_tpu.utils.benchcheck import flops_of
+
+    compiled = jax.jit(lambda a, b: a @ b).lower(
+        jnp.zeros((64, 64)), jnp.zeros((64, 64))).compile()
+    # XLA:CPU reliably reports flops for a matmul (2*n^3); a None here
+    # means the extractor itself regressed.
+    assert flops_of(compiled) == pytest.approx(2 * 64**3, rel=0.5)
+
+    class Garbage:
+        def cost_analysis(self):
+            raise RuntimeError("nope")
+
+    assert flops_of(Garbage()) is None
+    assert flops_of(object()) is None
