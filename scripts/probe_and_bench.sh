@@ -1,0 +1,88 @@
+#!/usr/bin/env bash
+# Probe-and-bench loop for the axon TPU tunnel (PERF.md §1c).
+#
+# The tunnel serves minutes-long windows separated by hours of outage
+# (measured r4: ~25 min in ~20 h, window arriving EARLY in the session),
+# so a session must start this loop at minute 0 or risk losing the round's
+# only measurement window to setup latency:
+#
+#     nohup scripts/probe_and_bench.sh >/dev/null 2>&1 &
+#
+# Behavior: probe the backend every PROBE_INTERVAL (default 420 s) with a
+# 120 s-timeout child (the axon claim loop can hang forever — the timeout
+# IS the probe's failure detector).  On the first successful probe, fire
+# the full measurement battery in priority order (most important first, so
+# a window that closes mid-battery still yields the top artifacts), then
+# exit 0 so the launching session is notified and can commit the artifacts.
+#
+# Battery order (VERDICT r4 item 1):
+#   1. bench.py           — 4 phases + fused cycle + batch sweep, self-
+#                           validating (MFU / linearity / sync-tail checks)
+#   2. bench_pallas_attention.py — native Mosaic compile + parity record
+#   3. bench_components.py       — per-op MFU attribution (profiler
+#                                  substitute; the tracer wedges the tunnel)
+#   4. 2-tick cli.train run      — real loop on the chip, stats.jsonl with
+#                                  per-tick timing/mfu
+#
+# While the battery runs, $OUT/BATTERY_RUNNING exists — do NOT start heavy
+# CPU work (the full pytest suite) while it is present; host contention
+# skews the device timings' host-side loop.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+OUT="${PROBE_OUT:-$REPO/.probe}"
+mkdir -p "$OUT"
+LOG="$OUT/probe.log"
+PROBE_INTERVAL="${PROBE_INTERVAL:-420}"
+
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+log() { echo "[$(stamp)] $*" >>"$LOG"; }
+
+probe() {
+    # PYTHONPATH stays ambient: the axon sitecustomize IS the TPU plugin.
+    timeout 120 python -c \
+        "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d; print(d[0].device_kind)" \
+        >>"$LOG" 2>&1
+}
+
+run_stage() {  # run_stage <timeout_s> <artifact|-> <cmd...>
+    local budget="$1" artifact="$2"; shift 2
+    log "stage start: $* (budget ${budget}s)"
+    if [ "$artifact" = "-" ]; then
+        timeout "$budget" "$@" >>"$LOG" 2>&1
+    else
+        timeout "$budget" "$@" >"$artifact" 2>>"$LOG"
+    fi
+    log "stage exit=$?: $1"
+}
+
+battery() {
+    local win="$OUT/window_$(date -u +%Y%m%dT%H%M%SZ)"
+    mkdir -p "$win"
+    touch "$OUT/BATTERY_RUNNING"
+    log "TPU reachable — battery firing into $win"
+
+    GRAFT_BENCH_TPU_TIMEOUT=2100 GRAFT_BENCH_SWEEP=16,32 \
+        run_stage 2700 "$win/bench_tpu.json" python bench.py
+    [ -f .bench_phases.json ] && cp .bench_phases.json "$win/bench_phases_tpu.json"
+
+    run_stage 900 "$win/pallas_tpu.json" python scripts/bench_pallas_attention.py
+    run_stage 900 "$win/components_tpu.json" python scripts/bench_components.py
+    run_stage 1200 - python -m gansformer_tpu.cli.train \
+        --preset ffhq256-duplex --data-source synthetic --batch-size 8 \
+        --total-kimg 8 --fused-cycle --results-dir "$win/train_tpu"
+
+    rm -f "$OUT/BATTERY_RUNNING"
+    log "battery complete: $(ls "$win")"
+}
+
+log "probe loop started (interval ${PROBE_INTERVAL}s, pid $$)"
+while true; do
+    if probe; then
+        battery
+        log "probe loop exiting after first successful battery"
+        exit 0
+    fi
+    log "probe failed; sleeping ${PROBE_INTERVAL}s"
+    sleep "$PROBE_INTERVAL"
+done
