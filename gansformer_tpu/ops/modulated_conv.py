@@ -128,8 +128,25 @@ def conv2d(x: jax.Array, w: jax.Array, up: int = 1, down: int = 1,
         # the conv at the higher resolution.
         x = upsample_2d(x, resample_filter, factor=up)
     if down > 1:
-        # Fold the VALID conv's padding into the blur, then stride the conv.
         f = setup_filter(resample_filter)
+        if kh == kw == 1:
+            # Skip/shortcut path (D residual blocks): a 1×1 stride-``down``
+            # conv reads only every ``down``-th blurred pixel, so blurring
+            # the full grid wastes down² − 1 of every down² blur outputs —
+            # the decimation mirror of the up-conv's structural-zero waste.
+            # Decimate INSIDE the blur (upfirdn's fused stride): only kept
+            # pixels are computed, cutting the depthwise work AND the
+            # intermediate's HBM round-trip 4× on the largest grids.
+            # Identical taps/positions to blur-then-stride — the 1×1 conv
+            # commutes with decimation exactly.
+            p = f.shape[0] - down
+            x = upfirdn2d(x, f, down=down, pad=((p + 1) // 2, p // 2))
+            return _conv(x, w, stride=1, padding="VALID")
+        # k>1: every blurred pixel is read by some stride-``down`` window,
+        # so there is nothing to decimate; fold the VALID conv's padding
+        # into the blur, then stride the conv.  (Folding the blur into the
+        # conv kernel instead — one 6×6 dense conv — costs 4× the dense
+        # MACs; rejected, PERF.md §1b''''.)
         p = (f.shape[0] - down) + (kh - 1)
         x = upfirdn2d(x, f, pad=((p + 1) // 2, p // 2))
         return _conv(x, w, stride=down, padding="VALID")

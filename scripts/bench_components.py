@@ -40,10 +40,9 @@ def main() -> None:
 
     import jax
 
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(_REPO, ".jax_compile_cache"))
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
+
+    enable_compile_cache(_REPO)
 
     import jax.numpy as jnp
     import numpy as np
@@ -51,7 +50,8 @@ def main() -> None:
     from gansformer_tpu.core.config import get_preset
     from gansformer_tpu.models.discriminator import Discriminator
     from gansformer_tpu.models.generator import Generator
-    from gansformer_tpu.ops.modulated_conv import _conv, modulated_conv2d
+    from gansformer_tpu.ops.modulated_conv import (
+        _conv, conv2d, modulated_conv2d)
     from gansformer_tpu.ops.upfirdn2d import downsample_2d, upsample_2d
     from gansformer_tpu.utils.benchcheck import peak_tflops
 
@@ -114,6 +114,24 @@ def main() -> None:
               x, res=res, chans=c)
         timed(f"blur_down2_{res}", lambda x: downsample_2d(x, (1, 3, 3, 1)),
               x, res=res, chans=c)
+        # D-skip 1x1 down-conv: decimated blur (current, PERF.md §1b'''')
+        # vs the dense formulation it replaced (blur every pixel, discard
+        # 3 of 4 in the strided conv) — the on-chip before/after.
+        c_out = cfg.nf(res // 2)
+        w1 = jnp.asarray(rs.randn(1, 1, c, c_out) * 0.1, dtype)
+        timed(f"skip_down_decimated_{res}",
+              lambda x, w: conv2d(x, w, down=2),
+              x, w1, res=res, cin=c, cout=c_out)
+
+        def skip_dense(x, w):
+            from gansformer_tpu.ops.upfirdn2d import setup_filter, upfirdn2d
+            fk = setup_filter((1, 3, 3, 1))
+            xb = upfirdn2d(x, fk, pad=((fk.shape[0] - 1) // 2,
+                                       (fk.shape[0] - 2) // 2))
+            return _conv(xb, w, stride=2, padding="VALID")
+
+        timed(f"skip_down_dense_{res}", skip_dense,
+              x, w1, res=res, cin=c, cout=c_out)
 
     # ---- model-level programs ----------------------------------------
     G, D = Generator(cfg), Discriminator(cfg)

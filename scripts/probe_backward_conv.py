@@ -1,0 +1,83 @@
+"""Cost-analysis probe: does XLA count/execute strided-conv backwards naively?
+
+Context (VERDICT r4 item 2): two candidate MFU optimizations for D's
+down-convs were on the table —
+
+  * phase-split the stride-2 conv into 4 stride-1 convs on input parity
+    grids so autodiff never emits an lhs-dilated (zero-inserting)
+    backward-input conv;
+  * fold the anti-aliasing blur's taps into the conv kernel (one 6x6
+    dense conv instead of blur + 3x3).
+
+This probe settles the first empirically: it lowers value-and-grad of a
+stride-2 3x3 conv at a flagship-like shape and reads XLA's post-
+optimization cost analysis.  If the backward-input conv were counted (and
+executed) as the naive zero-inserted correlation, grad-x would add ~4x the
+forward FLOPs; measured it adds exactly ~1x — XLA rewrites backward convs
+into efficient strided forms before cost analysis, so there is nothing for
+a hand-written polyphase backward to save.  (The r4 polyphase UP-conv win
+was different: there the *forward* op was lhs-dilated, which XLA does NOT
+rewrite.)  Recorded in PERF.md §1b''''.
+
+  PYTHONPATH= JAX_PLATFORMS=cpu python scripts/probe_backward_conv.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n, h, ci, co = 8, 256, 64, 128
+    x = jnp.zeros((n, h, h, ci), jnp.bfloat16)
+    w = jnp.zeros((3, 3, ci, co), jnp.bfloat16)
+
+    def conv_s2(x, w):
+        return lax.conv_general_dilated(
+            x, w, (2, 2), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def flops(fn, *args):
+        ca = jax.jit(fn).lower(*args).compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+
+    # Squared loss: a non-trivial cotangent, so the weight-grad conv cannot
+    # be algebraically simplified away (an all-ones cotangent from sum()
+    # lets XLA fold it into a reduce-window and hide its FLOPs).
+    def loss(x, w):
+        return jnp.sum(jnp.square(conv_s2(x, w).astype(jnp.float32)))
+
+    f_fwd = flops(conv_s2, x, w)
+    f_gx = flops(jax.grad(loss, 0), x, w)
+    f_gw = flops(jax.grad(loss, 1), x, w)
+    f_both = flops(jax.grad(loss, (0, 1)), x, w)
+    naive_gx = 2.0 * n * h * h * ci * co * 9
+    out = {
+        "shape": f"[{n},{h},{h},{ci}] * 3x3 s2 -> {co}",
+        "fwd_gflops": round(f_fwd / 1e9, 2),
+        "grad_x_gflops": round(f_gx / 1e9, 2),
+        "grad_w_gflops": round(f_gw / 1e9, 2),
+        "grad_both_gflops": round(f_both / 1e9, 2),
+        "grad_both_over_fwd": round(f_both / f_fwd, 3),
+        "naive_dilated_input_grad_gflops": round(naive_gx / 1e9, 2),
+        "verdict": ("backward convs counted/executed efficiently — "
+                    "polyphase backward has nothing to save"
+                    if f_both < 4.0 * f_fwd else
+                    "backward convs counted naively — polyphase backward "
+                    "would pay; re-evaluate"),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

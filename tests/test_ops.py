@@ -149,6 +149,40 @@ def test_conv2d_resampling_shapes(rng):
     assert ops.conv2d(x, w, down=2).shape == (2, 4, 4, 6)
 
 
+def test_conv2d_down_1x1_decimated_blur_exact(rng):
+    """The skip path's decimated blur (upfirdn down=2 computing only kept
+    pixels) must equal the dense formulation — blur every pixel, then let
+    the 1x1 stride-2 conv discard 3 of 4 — EXACTLY: same taps, same
+    positions, just never computing the discarded ones.  Grads included
+    (the skip sits inside D, under R1's second-order grads)."""
+    from gansformer_tpu.ops.modulated_conv import _conv
+    from gansformer_tpu.ops.upfirdn2d import setup_filter, upfirdn2d
+
+    x = jnp.asarray(rng.randn(2, 16, 16, 4).astype(np.float32))
+    w = jnp.asarray((rng.randn(1, 1, 4, 6) * 0.5).astype(np.float32))
+    f = (1, 3, 3, 1)
+
+    def dense(x, w):
+        fk = setup_filter(f)
+        p = (fk.shape[0] - 2) + 0
+        xb = upfirdn2d(x, fk, pad=((p + 1) // 2, p // 2))
+        return _conv(xb, w, stride=2, padding="VALID")
+
+    got = ops.conv2d(x, w, down=2, resample_filter=f)
+    want = dense(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6, rtol=1e-6)
+
+    loss_got = lambda x, w: jnp.sum(jnp.square(
+        ops.conv2d(x, w, down=2, resample_filter=f)))
+    loss_want = lambda x, w: jnp.sum(jnp.square(dense(x, w)))
+    for arg in (0, 1):
+        g = jax.grad(loss_got, arg)(x, w)
+        g_ref = jax.grad(loss_want, arg)(x, w)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
 def test_conv_transpose_poly_exact(rng):
     # The polyphase decomposition must equal a SAME-padded correlation over
     # the zero-inserted 2x grid EXACTLY (it reads the same taps, reordered).
