@@ -88,9 +88,531 @@ def _is_oom(e: BaseException) -> bool:
     return "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
 
 
+def build_phase_artifact(*, metric: str, on_tpu: bool, n_chips: int,
+                         platform: str, bsz: int, timings: dict, flops: dict,
+                         fetch_s: dict, compile_s: dict, identity: dict,
+                         peak, d_reg_interval: int, g_reg_interval: int,
+                         iters: int, linearity: dict, device_kind: str,
+                         partial: bool) -> dict:
+    """Measurement numbers → the phase-weighted artifact dict (VERDICT r4
+    weak #4: the logic that decides whether a number is real, as a PURE
+    function on plain dicts — unit-testable without a device).
+
+    Computes the cadence-weighted img/s/chip, per-phase + weighted MFU,
+    and runs the physics/consistency checks (``find_suspects``); a result
+    failing any check carries ``suspect`` instead of being presented
+    clean.  The partial form (only d+g timed) approximates reg phases
+    with the plain ones — systematically HIGH, so it is labeled."""
+    from gansformer_tpu.utils.benchcheck import (
+        cadence_weighted, find_suspects, mfu as mfu_of)
+
+    def weighted(vals: dict) -> float:
+        return cadence_weighted(vals, d_reg_interval, g_reg_interval)
+
+    per_chip = bsz / weighted(timings) / n_chips
+    out = {
+        "metric": metric,
+        "value": round(per_chip, 2),
+        "unit": "img/sec/chip",
+        # A clevr64 CPU proxy has no meaningful ratio against the
+        # ffhq256 TPU baseline (VERDICT r3 weak #6): null, not noise.
+        "vs_baseline": (round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4)
+                        if on_tpu else None),
+        "n_chips": n_chips,
+        "platform": platform,
+        "batch_per_chip": bsz // n_chips,
+        "phase_ms": {k: round(v * 1e3, 2) for k, v in timings.items()},
+        "fetch_sync_tail_s": {k: round(v, 3) for k, v in fetch_s.items()},
+        "compile_s": {k: round(v, 1) for k, v in compile_s.items()},
+        "device": identity,
+    }
+    if not on_tpu:
+        out["vs_baseline_note"] = (
+            "cpu proxy (clevr64-simplex) — not comparable to the "
+            "ffhq256 TPU target; no ratio reported")
+    if flops:
+        out["phase_gflops_per_chip"] = {
+            k: round(v / 1e9, 1) for k, v in flops.items()}
+    if peak:
+        out["peak_bf16_tflops_per_chip"] = peak
+        out["phase_mfu"] = {
+            k: round(flops[k] / timings[k] / (peak * 1e12), 4)
+            for k in timings if k in flops}
+        if not partial and all(k in flops for k in timings):
+            out["mfu"] = round(
+                mfu_of(weighted(flops), weighted(timings), peak), 4)
+    sus = find_suspects(
+        timings, flops, d_reg_interval=d_reg_interval,
+        g_reg_interval=g_reg_interval, peak=peak, device_kind=device_kind,
+        iters=iters, fetch_tails=fetch_s, linearity=linearity)
+    if sus:
+        out["suspect"] = sus
+    if partial:
+        out["partial"] = "reg variants not yet measured"
+    return out
+
+
+def build_cycle_artifact(*, metric: str, n_chips: int, platform: str,
+                         bsz: int, k_cyc: int, per_call_s: float,
+                         tail_s: float, n_calls: int, compile_s: float,
+                         identity: dict, peak, cycle_flops,
+                         device_kind: str) -> dict:
+    """Fused-cycle measurement → artifact dict (pure, unit-testable).
+
+    ``cycle_flops`` is the per-call figure derived from the PHASE cost
+    analyses × cadence × cycle length (the cycle program's own cost
+    analysis counts its scan bodies once, not × trip count — see
+    ``_BenchSession.measure_cycle``); None when the phase analyses are
+    unavailable.  Carries its own suspect checks (physics + early-ack
+    tail) so a bad cycle number can never be emitted clean."""
+    per_chip = bsz * k_cyc / per_call_s / n_chips
+    out = {
+        "metric": metric,
+        "value": round(per_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+        "method": f"fused_cycle_{k_cyc}",
+        "n_chips": n_chips,
+        "platform": platform,
+        "batch_per_chip": bsz // n_chips,
+        "cycle_ms": round(per_call_s * 1e3, 2),
+        "fetch_sync_tail_s": {"cycle": round(tail_s, 3)},
+        "compile_s": {"cycle": round(compile_s, 1)},
+        "device": identity,
+    }
+    sus = []
+    if cycle_flops:
+        out["cycle_gflops_per_chip"] = round(cycle_flops / 1e9, 1)
+        out["cycle_flops_source"] = \
+            "phase cost analysis x cadence (scan bodies count once)"
+        if peak:
+            m = cycle_flops / per_call_s / (peak * 1e12)
+            out["peak_bf16_tflops_per_chip"] = peak
+            out["mfu"] = round(m, 4)
+            if m >= 1.0:
+                sus.append(
+                    f"mfu {m:.2f} >= 1.0 — implied throughput exceeds "
+                    f"{device_kind} bf16 peak")
+    if tail_s > 0.3 * per_call_s * n_calls + 1.0:
+        sus.append(f"cycle: device_get sync tail {tail_s:.2f}s after a "
+                   f"{per_call_s * n_calls:.2f}s timed loop — early acks")
+    if sus:
+        out["suspect"] = sus
+    return out
+
+
+class _BenchSession:
+    """Mutable bench state + the measurement stages (VERDICT r4 weak #4:
+    one ~570-line closure became stages with seams).  Artifact CONTENT is
+    built by the pure module-level builders; this class owns the device
+    work (compile, time, fetch) and the run bookkeeping (best result,
+    OOM notes, witness refs, incremental emission)."""
+
+    def __init__(self, cfg, env, *, metric: str, on_tpu: bool,
+                 iters: int, peak, identity: dict, profile_dir):
+        import jax
+
+        self.cfg = cfg
+        self.env = env
+        self.metric = metric
+        self.on_tpu = on_tpu
+        self.iters = iters
+        self.peak = peak
+        self.identity = identity
+        self.profile_dir = profile_dir
+        self.n_chips = len(jax.devices())
+        self.platform = jax.devices()[0].platform
+        self.device_kind = jax.devices()[0].device_kind
+        self.res = cfg.model.resolution
+        self.rng = jax.random.PRNGKey(1)
+        self.t = cfg.train
+
+        self.best = 0.0        # best emitted img/s/chip (any method)
+        self.last_out: dict = {}   # last emitted JSON (sweep annotation)
+        self.sweep_notes: list = []  # OOM history; survives later emits
+        self.phase_results: dict = {}  # global batch -> (timings, flops)
+        self.witness_refs: dict = {}   # global batch -> (d compiled, args)
+        #   — keyed by batch so the traced program always matches the
+        #   batch of the artifact it annotates
+        self.cycle_oom_bsz = None  # smallest global batch whose CYCLE OOMed
+        self.state = self.fresh_state()
+
+    def fresh_state(self):
+        """jit the whole init: ONE compiled program instead of hundreds of
+        small eager dispatches (each a round-trip over the axon TPU
+        tunnel).  Also the recovery path after an OOM: the step fns donate
+        the state buffers, so a failed measure() leaves the old ``state``
+        pointing at deleted arrays."""
+        import jax
+
+        from gansformer_tpu.train.state import create_train_state
+
+        t_init = time.time()
+        st = jax.jit(lambda k: create_train_state(self.cfg, k))(
+            jax.random.PRNGKey(0))
+        jax.block_until_ready(st.step)
+        _log(f"state init in {time.time() - t_init:.1f}s")
+        return jax.device_put(st, self.env.replicated())
+
+    def emit_json(self, out: dict) -> None:
+        """THE artifact-emission path (stdout line + phases file +
+        last_out) — shared by the phase-weighted and fused-cycle
+        emitters."""
+        if self.sweep_notes:
+            out["sweep_stopped"] = list(self.sweep_notes)
+        if os.environ.get("GRAFT_BENCH_TRACE", "0") == "1":
+            # Trace mode pins each linearity-probed d executable (and its
+            # donated-arg HBM buffers) for the witness — a sweep OOM under
+            # this flag may not reproduce untraced; make it attributable.
+            out["trace_mode"] = True
+        self.last_out.clear()
+        self.last_out.update(out)
+        print(json.dumps(out), flush=True)
+        try:
+            with open(_PHASES_OUT, "w") as f:
+                json.dump(out, f, indent=2)
+        except OSError:
+            pass
+
+    def note_oom(self, msg: str) -> None:
+        """Append (never overwrite) the OOM record in the final artifact."""
+        self.sweep_notes.append(msg)
+        if self.last_out:
+            self.last_out["sweep_stopped"] = list(self.sweep_notes)
+            print(json.dumps(self.last_out), flush=True)
+
+    def _phase_fns(self, bsz: int):
+        import dataclasses
+
+        from gansformer_tpu.train.steps import make_train_steps
+
+        b_cfg = dataclasses.replace(
+            self.cfg,
+            train=dataclasses.replace(self.cfg.train, batch_size=bsz))
+        return make_train_steps(b_cfg, self.env, batch_size=bsz)
+
+    def measure(self, bsz: int, emit_only_if_better: bool) -> float:
+        """Compile+time the 4 lazy-reg phase variants at one global batch;
+        emits JSON lines (the outer process takes the LAST parseable one,
+        so emitting only-on-improvement keeps the best config's number)."""
+        import jax
+        import numpy as np
+
+        from gansformer_tpu.utils.benchcheck import (
+            cadence_weighted, flops_of as _flops_of)
+
+        fns = self._phase_fns(bsz)
+        imgs = jax.device_put(
+            np.random.RandomState(0).randint(
+                0, 255, (bsz, self.res, self.res, 3), dtype=np.uint8),
+            self.env.batch())
+        # Phase plan: steady-state pair first so a partial result exists
+        # as early as possible; reg variants (second-order grads, the
+        # compile hogs) after.
+        phases = [
+            ("d", fns.d_step, (imgs, self.rng)),
+            ("g", fns.g_step, (self.rng,)),
+            ("d_r1", fns.d_step_r1, (imgs, self.rng)),
+            ("g_pl", fns.g_step_pl, (self.rng,)),
+        ]
+        timings: dict = {}    # per-it wall to block_until_ready (reported)
+        fetch_s: dict = {}    # post-block sync tail of a real device_get
+        compile_s: dict = {}
+        flops: dict = {}      # PER-DEVICE FLOPs per phase (see flops_of)
+        linearity: dict = {}  # per-it time at N vs 2N iterations
+
+        def per_chip_now() -> float:
+            return bsz / cadence_weighted(
+                timings, self.t.d_reg_interval,
+                self.t.g_reg_interval) / self.n_chips
+
+        def emit(partial: bool) -> None:
+            per_chip = per_chip_now()
+            if emit_only_if_better and partial:
+                # The partial estimate approximates the (slower) reg
+                # variants with the plain steps, so it is systematically
+                # HIGH — emitting it in sweep mode could make an inflated
+                # number from a worse config the final reported line.
+                return
+            if emit_only_if_better and per_chip <= self.best:
+                _log(f"batch {bsz // self.n_chips}/chip: {per_chip:.1f} "
+                     f"img/s — not better than {self.best:.1f}, "
+                     f"not emitting")
+                return
+            self.emit_json(build_phase_artifact(
+                metric=self.metric, on_tpu=self.on_tpu,
+                n_chips=self.n_chips, platform=self.platform, bsz=bsz,
+                timings=timings, flops=flops, fetch_s=fetch_s,
+                compile_s=compile_s, identity=self.identity,
+                peak=self.peak, d_reg_interval=self.t.d_reg_interval,
+                g_reg_interval=self.t.g_reg_interval, iters=self.iters,
+                linearity=linearity, device_kind=self.device_kind,
+                partial=partial))
+
+        st = self.state
+        for name, fn, extra in phases:
+            tc = time.time()
+            compiled = fn.lower(st, *extra).compile()
+            compile_s[name] = time.time() - tc
+            fl = _flops_of(compiled)
+            if fl:
+                flops[name] = fl
+            _log(f"[b{bsz}] compiled {name} in {compile_s[name]:.1f}s"
+                 + (f" ({fl / 1e12:.3f} TFLOP/call)" if fl else ""))
+            # warm-up call (also replaces donated state)
+            st, _ = compiled(st, *extra)
+            jax.block_until_ready(st.step)
+
+            def timed(n_it):
+                """(per-it s to block_until_ready, post-block sync tail s).
+                The tail forces a real device→host transfer of a loss
+                scalar data-dependent on the final step — an ack-early
+                relay cannot fake the value, so a long tail exposes a
+                lying block clock (checked in build_phase_artifact)."""
+                nonlocal st
+                t0 = time.time()
+                out = None
+                for _ in range(n_it):
+                    st, out = compiled(st, *extra)
+                jax.block_until_ready(st.step)
+                t_block = time.time()
+                float(np.asarray(jax.device_get(
+                    jax.tree_util.tree_leaves(out)[0])).ravel()[0])
+                return (t_block - t0) / n_it, time.time() - t_block
+
+            timings[name], fetch_s[name] = timed(self.iters)
+            _log(f"[b{bsz}] timed {name}: {timings[name] * 1e3:.1f} ms/step "
+                 f"(sync tail {fetch_s[name] * 1e3:.0f} ms)")
+            if name == "d" and self.on_tpu:
+                # Linearity probe: per-it time must hold at doubled work.
+                per_it_2n, _ = timed(2 * self.iters)
+                linearity[name] = (timings[name], per_it_2n)
+                _log(f"[b{bsz}] linearity d: {per_it_2n * 1e3:.1f} ms/step "
+                     f"at 2x iters")
+                if os.environ.get("GRAFT_BENCH_TRACE", "0") == "1":
+                    # Only when the witness will actually run: the stored
+                    # executable pins its donated-arg image buffers in HBM
+                    # for the rest of the process.
+                    self.witness_refs[bsz] = (compiled, extra)
+            if name == "g":
+                emit(partial=True)
+        self.state = st
+        emit(partial=False)
+        self.phase_results[bsz] = (dict(timings), dict(flops))
+        return per_chip_now()
+
+    def measure_cycle(self, bsz: int) -> None:
+        """Time the FUSED lazy-reg cycle (TrainStepFns.cycle — the whole
+        16-iteration hot loop as ONE program, the loop's --fused-cycle
+        mode): same per-iteration work as the phase-weighted number but
+        1 host dispatch per cycle instead of 32, so it bounds dispatch/
+        relay overhead from above.  TPU only; invoked via ``try_cycle``
+        BEFORE the sweep at the default batch (the tunnel-overhead
+        datapoint must not queue behind the optional sweep) and again
+        after it if the sweep finds a better batch.  Emits a better final
+        line only if it beats the emitted best and passes validation.
+
+        FLOPs note: XLA cost analysis counts a ``lax.scan`` body ONCE,
+        not × trip count (verified empirically — a scanned matmul chain
+        reports 1/8 of its unrolled FLOPs), so the cycle program's own
+        cost analysis undercounts ~5×.  The cycle's true per-call FLOPs
+        are derived from the four PHASE measurements at the same batch:
+        cadence-weighted per-iteration FLOPs × cycle length."""
+        import jax
+        import numpy as np
+
+        from gansformer_tpu.utils.benchcheck import cadence_weighted
+
+        fns = self._phase_fns(bsz)
+        if fns.cycle is None:
+            return
+        k_cyc = fns.cycle_len
+        imgs_k = jax.device_put(
+            np.random.RandomState(0).randint(
+                0, 255, (k_cyc, bsz, self.res, self.res, 3), dtype=np.uint8),
+            self.env.batch_stack())
+        tc = time.time()
+        compiled = fns.cycle.lower(self.state, imgs_k, self.rng, 0).compile()
+        c_s = time.time() - tc
+        _, ph_flops = self.phase_results.get(bsz, ({}, {}))
+        fl = (cadence_weighted(ph_flops, self.t.d_reg_interval,
+                               self.t.g_reg_interval) * k_cyc
+              if all(k in ph_flops for k in ("d", "g", "d_r1", "g_pl"))
+              else None)
+        _log(f"[b{bsz}] compiled cycle{k_cyc} in {c_s:.1f}s"
+             + (f" ({fl / 1e12:.3f} TFLOP/call from phase analysis)"
+                if fl else ""))
+        st, sums = compiled(self.state, imgs_k, self.rng, 0)   # warm-up
+        jax.block_until_ready(st.step)
+        n_calls = max(2, self.iters // k_cyc * 2)
+        t0 = time.time()
+        for _ in range(n_calls):
+            st, sums = compiled(st, imgs_k, self.rng, 0)
+        jax.block_until_ready(st.step)
+        t_block = time.time()
+        float(np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(sums)[0])).ravel()[0])
+        tail = time.time() - t_block
+        self.state = st
+        per_call = (t_block - t0) / n_calls
+        per_chip = bsz * k_cyc / per_call / self.n_chips
+        _log(f"[b{bsz}] timed cycle{k_cyc}: {per_call * 1e3:.1f} ms/cycle "
+             f"= {per_chip:.1f} img/s/chip (sync tail {tail * 1e3:.0f} ms)")
+        out = build_cycle_artifact(
+            metric=self.metric, n_chips=self.n_chips, platform=self.platform,
+            bsz=bsz, k_cyc=k_cyc, per_call_s=per_call, tail_s=tail,
+            n_calls=n_calls, compile_s=c_s, identity=self.identity,
+            peak=self.peak, cycle_flops=fl, device_kind=self.device_kind)
+        if per_chip > self.best and "suspect" not in out:
+            self.best = per_chip
+            self.emit_json(out)
+        else:
+            _log(f"cycle{k_cyc}: {per_chip:.1f} img/s/chip — not better "
+                 f"than {self.best:.1f} (or suspect), not emitting")
+
+    def try_cycle(self, bsz: int, label: str, budget: float) -> None:
+        """measure_cycle as a best-effort extra: an OOM or any other
+        cycle-only failure is recorded in the artifact and must never
+        cost the remaining measurements (the cycle program is a scan
+        the four phase programs don't exercise — a lowering bug there
+        should not kill the sweep)."""
+        if self.cycle_oom_bsz is not None and bsz >= self.cycle_oom_bsz:
+            _log(f"cycle: skipping batch {bsz // self.n_chips}/chip "
+                 f"(>= known cycle OOM at "
+                 f"{self.cycle_oom_bsz // self.n_chips}/chip)")
+            return
+        if time.time() - _T0 > budget - 180:
+            _log(f"cycle ({label}): skipping (outer budget nearly spent)")
+            return
+        try:
+            self.measure_cycle(bsz)
+        except Exception as e:
+            if _is_oom(e):
+                self.cycle_oom_bsz = min(bsz, self.cycle_oom_bsz or bsz)
+                self.note_oom(f"cycle oom at batch {bsz // self.n_chips}"
+                              f"/chip ({label}; stacked input adds "
+                              f"{self.cfg.train.d_reg_interval}x batch "
+                              f"of uint8)")
+            else:
+                _log(f"cycle ({label}) failed (non-fatal): "
+                     f"{type(e).__name__}: {str(e)[:300]}")
+                self.sweep_notes.append(
+                    f"cycle failed at batch {bsz // self.n_chips}/chip: "
+                    f"{type(e).__name__}")
+            self.state = self.fresh_state()   # buffers were donated & lost
+
+    def run_witness(self) -> None:
+        """Device-time witness (VERDICT r3 item 1b): trace a short window
+        of the ``d`` phase; the xplane's DEVICE plane records what the
+        chip actually executed — relay acks cannot fake it.  Runs LAST,
+        after every measurement is already emitted:
+        ``jax.profiler.start_trace`` was observed to HANG forever over the
+        axon tunnel (r4, 2026-07-31 — an 1800s budget died inside the
+        tracer before any JSON was emitted), and incremental emission
+        means a hang here costs nothing but the witness itself.  On
+        success the final artifact is re-emitted with ``device_trace``
+        attached (plus a ``suspect`` entry if the device time contradicts
+        the claimed wall).
+
+        OPT-IN (GRAFT_BENCH_TRACE=1): the tracer hang is not just a lost
+        budget — the client killed mid-trace left the tunnel's backend
+        claim WEDGED for every subsequent process for 20+ minutes (r4,
+        observed).  A witness that can poison the shared backend must not
+        run unattended; the sync-tail fetch + linearity probe remain the
+        always-on device-time evidence (VERDICT r3 item 1b's "at minimum"
+        clause)."""
+        import jax
+
+        if (not self.on_tpu or self.profile_dir or not self.witness_refs
+                or not self.last_out
+                or os.environ.get("GRAFT_BENCH_TRACE", "0") != "1"):
+            return
+        # Trace the d program of the BATCH THE FINAL ARTIFACT REPORTS, so
+        # the attached evidence always describes the measured config (the
+        # fused-cycle line runs at the best phase-weighted batch, so the
+        # same program matches it too).
+        bsz = int(self.last_out.get("batch_per_chip", 0)) * self.n_chips
+        if bsz not in self.witness_refs:
+            _log(f"trace witness: no d program kept for batch "
+                 f"{bsz // max(self.n_chips, 1)}/chip — skipping")
+            return
+        import shutil
+        import tempfile
+
+        from gansformer_tpu.utils.benchcheck import trace_suspect
+        from gansformer_tpu.utils.profparse import device_busy_span
+
+        compiled, extra = self.witness_refs[bsz]
+        t_d = self.phase_results.get(bsz, ({}, {}))[0].get("d", 0.0)
+        tdir = tempfile.mkdtemp(prefix="graft_bench_trace_")
+        n_tr = min(10, self.iters)
+        st = self.state
+        try:
+            _log("trace witness: starting profiler "
+                 "(opt-in; runs last — a tunnel hang here cannot cost "
+                 "any already-emitted result)")
+            jax.profiler.start_trace(tdir)
+            try:
+                t0_tr = time.time()
+                for _ in range(n_tr):
+                    st, _ = compiled(st, *extra)
+                jax.block_until_ready(st.step)
+                wall_tr = time.time() - t0_tr
+            finally:
+                jax.profiler.stop_trace()
+            self.state = st
+            dev = device_busy_span(tdir)
+            if not dev:
+                _log("trace witness: no parseable device plane (non-fatal)")
+                return
+            busy, span, plane = dev
+            tc = {"busy_s": round(busy, 4), "span_s": round(span, 4),
+                  "wall_s": round(wall_tr, 4), "iters": n_tr, "plane": plane}
+            _log(f"trace witness: device busy {busy * 1e3:.1f} ms over "
+                 f"{n_tr} iters (wall {wall_tr * 1e3:.1f} ms, plane {plane})")
+            if self.last_out:
+                out = dict(self.last_out)
+                out["device_trace"] = tc
+                ts = trace_suspect(busy, wall_tr, n_tr, t_d)
+                if ts:
+                    out["suspect"] = out.get("suspect", []) + [ts]
+                self.emit_json(out)
+        except Exception as e:
+            _log(f"trace witness failed (non-fatal): "
+                 f"{type(e).__name__}: {str(e)[:200]}")
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
+
+
+def _device_identity() -> dict:
+    """Device identity evidence (VERDICT r3 item 1c): enough to answer
+    "was this really N chips of kind K?" from the artifact alone."""
+    import jax
+
+    dev0 = jax.devices()[0]
+    identity = {
+        "device_kind": dev0.device_kind,
+        "platform": dev0.platform,
+        "n_devices": len(jax.devices()),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+    }
+    try:
+        mstats = dev0.memory_stats() or {}
+        identity["memory_stats"] = {
+            k: int(mstats[k]) for k in
+            ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
+            if k in mstats}
+    except Exception:
+        pass
+    return identity
+
+
 def _run_inner() -> None:
-    """The actual benchmark. Emits progress on stderr and one-or-more JSON
-    lines on stdout (the last one wins)."""
+    """The benchmark driver: backend/config setup, then the stage plan —
+    default-batch measure (OOM-halving once), pre-sweep fused cycle,
+    batch sweep, post-sweep cycle, opt-in trace witness.  Emits progress
+    on stderr and one-or-more JSON lines on stdout (the last one wins)."""
     import dataclasses
 
     import jax
@@ -104,15 +626,9 @@ def _run_inner() -> None:
 
     enable_compile_cache(_REPO)
 
-    import numpy as np
-
     from gansformer_tpu.core.config import get_preset
     from gansformer_tpu.parallel.mesh import make_mesh
-    from gansformer_tpu.train.state import create_train_state
-    from gansformer_tpu.train.steps import make_train_steps
-    from gansformer_tpu.utils.benchcheck import (
-        cadence_weighted, find_suspects, flops_of as _flops_of,
-        mfu as mfu_of, peak_tflops)
+    from gansformer_tpu.utils.benchcheck import peak_tflops
 
     n_chips = len(jax.devices())
     platform = jax.devices()[0].platform
@@ -134,412 +650,28 @@ def _run_inner() -> None:
     metric = ("train_img_per_sec_per_chip_ffhq256_duplex" if on_tpu
               else "train_img_per_sec_per_chip_cpu_proxy")
 
-    env = make_mesh(cfg.mesh)
-
-    def fresh_state():
-        # jit the whole init: ONE compiled program instead of hundreds of
-        # small eager dispatches (each a round-trip over the axon TPU
-        # tunnel).  Also the recovery path after an OOM: the step fns
-        # donate the state buffers, so a failed measure() leaves the old
-        # ``state`` pointing at deleted arrays.
-        t_init = time.time()
-        st = jax.jit(lambda k: create_train_state(cfg, k))(
-            jax.random.PRNGKey(0))
-        jax.block_until_ready(st.step)
-        _log(f"state init in {time.time() - t_init:.1f}s")
-        return jax.device_put(st, env.replicated())
-
-    state = fresh_state()
-
-    res = cfg.model.resolution
-    rng = jax.random.PRNGKey(1)
-    t = cfg.train
-    iters = 20 if on_tpu else 3
-
     profile_dir = os.environ.get("GRAFT_BENCH_PROFILE")
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
 
-    # Device identity evidence (VERDICT r3 item 1c): enough to answer
-    # "was this really N chips of kind K?" from the artifact alone.
     dev0 = jax.devices()[0]
-    peak = peak_tflops(dev0.device_kind) if on_tpu else None
-    identity = {
-        "device_kind": dev0.device_kind,
-        "platform": platform,
-        "n_devices": n_chips,
-        "local_device_count": jax.local_device_count(),
-        "process_count": jax.process_count(),
-    }
-    try:
-        mstats = dev0.memory_stats() or {}
-        identity["memory_stats"] = {
-            k: int(mstats[k]) for k in
-            ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
-            if k in mstats}
-    except Exception:
-        pass
+    identity = _device_identity()
 
-    best = 0.0              # best emitted img/s/chip (any method)
-    best_phase = 0.0        # best PHASE-WEIGHTED result (sweep tracking —
-    #                         the cycle number must not hide a better batch)
-    best_bsz = 0            # global batch of the best phase-weighted result
-    last_out: dict = {}     # last emitted JSON (for sweep_stopped annotation)
-    sweep_notes: list = []  # OOM history; survives later emits
-    phase_results: dict = {}   # global batch -> (timings, flops) from measure
-    witness_refs: dict = {}    # global batch -> (d-phase compiled, args) for
-    #                            the end witness — keyed by batch so the
-    #                            traced program always matches the batch of
-    #                            the artifact it annotates
+    sess = _BenchSession(
+        cfg, make_mesh(cfg.mesh), metric=metric, on_tpu=on_tpu,
+        iters=20 if on_tpu else 3,
+        peak=peak_tflops(dev0.device_kind) if on_tpu else None,
+        identity=identity, profile_dir=profile_dir)
 
-    def emit_json(out: dict) -> None:
-        """THE artifact-emission path (stdout line + phases file + last_out)
-        — shared by the phase-weighted and fused-cycle emitters."""
-        if sweep_notes:
-            out["sweep_stopped"] = list(sweep_notes)
-        last_out.clear()
-        last_out.update(out)
-        print(json.dumps(out), flush=True)
-        try:
-            with open(_PHASES_OUT, "w") as f:
-                json.dump(out, f, indent=2)
-        except OSError:
-            pass
-
-    def measure(bsz: int, emit_only_if_better: bool) -> float:
-        """Compile+time the 4 lazy-reg phase variants at one global batch;
-        emits JSON lines (the outer process takes the LAST parseable one,
-        so emitting only-on-improvement keeps the best config's number)."""
-        nonlocal state
-        b_cfg = dataclasses.replace(
-            cfg, train=dataclasses.replace(cfg.train, batch_size=bsz))
-        fns = make_train_steps(b_cfg, env, batch_size=bsz)
-        imgs = jax.device_put(
-            np.random.RandomState(0).randint(
-                0, 255, (bsz, res, res, 3), dtype=np.uint8), env.batch())
-        # Phase plan: steady-state pair first so a partial result exists
-        # as early as possible; reg variants (second-order grads, the
-        # compile hogs) after.
-        phases = [
-            ("d", fns.d_step, (imgs, rng)),
-            ("g", fns.g_step, (rng,)),
-            ("d_r1", fns.d_step_r1, (imgs, rng)),
-            ("g_pl", fns.g_step_pl, (rng,)),
-        ]
-        timings: dict = {}    # per-it wall to block_until_ready (reported)
-        fetch_s: dict = {}    # post-block sync tail of a real device_get
-        compile_s: dict = {}
-        flops: dict = {}      # PER-DEVICE FLOPs per phase (see _flops_of)
-        linearity: dict = {}  # per-it time at N vs 2N iterations
-
-        def weighted(vals: dict) -> float:
-            return cadence_weighted(vals, t.d_reg_interval, t.g_reg_interval)
-
-        def per_chip_now() -> float:
-            return bsz / weighted(timings) / n_chips
-
-        def suspects() -> list:
-            """Physics/consistency checks (VERDICT r3 item 1a): a result
-            failing any of these is flagged, never silently reported.
-            The checks are pure functions in utils/benchcheck.py, unit-
-            tested in tests/test_benchcheck.py."""
-            out = find_suspects(
-                timings, flops,
-                d_reg_interval=t.d_reg_interval,
-                g_reg_interval=t.g_reg_interval,
-                peak=peak, device_kind=dev0.device_kind, iters=iters,
-                fetch_tails=fetch_s, linearity=linearity)
-            return out
-
-        def emit(partial: bool) -> None:
-            per_chip = per_chip_now()
-            if emit_only_if_better and partial:
-                # The partial estimate approximates the (slower) reg
-                # variants with the plain steps, so it is systematically
-                # HIGH — emitting it in sweep mode could make an inflated
-                # number from a worse config the final reported line.
-                return
-            if emit_only_if_better and per_chip <= best:
-                _log(f"batch {bsz // n_chips}/chip: {per_chip:.1f} img/s — "
-                     f"not better than {best:.1f}, not emitting")
-                return
-            out = {
-                "metric": metric,
-                "value": round(per_chip, 2),
-                "unit": "img/sec/chip",
-                # A clevr64 CPU proxy has no meaningful ratio against the
-                # ffhq256 TPU baseline (VERDICT r3 weak #6): null, not noise.
-                "vs_baseline": (round(
-                    per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4)
-                    if on_tpu else None),
-                "n_chips": n_chips,
-                "platform": platform,
-                "batch_per_chip": bsz // n_chips,
-                "phase_ms": {k: round(v * 1e3, 2) for k, v in timings.items()},
-                "fetch_sync_tail_s": {
-                    k: round(v, 3) for k, v in fetch_s.items()},
-                "compile_s": {k: round(v, 1) for k, v in compile_s.items()},
-                "device": identity,
-            }
-            if not on_tpu:
-                out["vs_baseline_note"] = (
-                    "cpu proxy (clevr64-simplex) — not comparable to the "
-                    "ffhq256 TPU target; no ratio reported")
-            if flops:
-                out["phase_gflops_per_chip"] = {
-                    k: round(v / 1e9, 1) for k, v in flops.items()}
-            if peak:
-                out["peak_bf16_tflops_per_chip"] = peak
-                out["phase_mfu"] = {
-                    k: round(flops[k] / timings[k] / (peak * 1e12), 4)
-                    for k in timings if k in flops}
-                if not partial and all(k in flops for k in timings):
-                    out["mfu"] = round(
-                        mfu_of(weighted(flops), weighted(timings), peak), 4)
-            sus = suspects()
-            if sus:
-                out["suspect"] = sus
-            if partial:
-                out["partial"] = "reg variants not yet measured"
-            emit_json(out)
-
-        st = state
-        for name, fn, extra in phases:
-            tc = time.time()
-            compiled = fn.lower(st, *extra).compile()
-            compile_s[name] = time.time() - tc
-            fl = _flops_of(compiled)
-            if fl:
-                flops[name] = fl
-            _log(f"[b{bsz}] compiled {name} in {compile_s[name]:.1f}s"
-                 + (f" ({fl / 1e12:.3f} TFLOP/call)" if fl else ""))
-            # warm-up call (also replaces donated state)
-            st, _ = compiled(st, *extra)
-            jax.block_until_ready(st.step)
-
-            def timed(n_it):
-                """(per-it s to block_until_ready, post-block sync tail s).
-                The tail forces a real device→host transfer of a loss
-                scalar data-dependent on the final step — an ack-early
-                relay cannot fake the value, so a long tail exposes a
-                lying block clock (validated in suspects())."""
-                nonlocal st
-                t0 = time.time()
-                out = None
-                for _ in range(n_it):
-                    st, out = compiled(st, *extra)
-                jax.block_until_ready(st.step)
-                t_block = time.time()
-                float(np.asarray(jax.device_get(
-                    jax.tree_util.tree_leaves(out)[0])).ravel()[0])
-                return (t_block - t0) / n_it, time.time() - t_block
-
-            timings[name], fetch_s[name] = timed(iters)
-            _log(f"[b{bsz}] timed {name}: {timings[name] * 1e3:.1f} ms/step "
-                 f"(sync tail {fetch_s[name] * 1e3:.0f} ms)")
-            if name == "d" and on_tpu:
-                # Linearity probe: per-it time must hold at doubled work.
-                per_it_2n, _ = timed(2 * iters)
-                linearity[name] = (timings[name], per_it_2n)
-                _log(f"[b{bsz}] linearity d: {per_it_2n * 1e3:.1f} ms/step "
-                     f"at 2x iters")
-                if os.environ.get("GRAFT_BENCH_TRACE", "0") == "1":
-                    # Only when the witness will actually run: the stored
-                    # executable pins its donated-arg image buffers in HBM
-                    # for the rest of the process.
-                    witness_refs[bsz] = (compiled, extra)
-            if name == "g":
-                emit(partial=True)
-        state = st
-        emit(partial=False)
-        phase_results[bsz] = (dict(timings), dict(flops))
-        return per_chip_now()
-
-    def measure_cycle(bsz: int) -> None:
-        """Time the FUSED lazy-reg cycle (TrainStepFns.cycle — the whole
-        16-iteration hot loop as ONE program, the loop's --fused-cycle
-        mode): same per-iteration work as the phase-weighted number but
-        1 host dispatch per cycle instead of 32, so it bounds dispatch/
-        relay overhead from above.  TPU only; invoked via ``try_cycle``
-        BEFORE the sweep at the default batch (the tunnel-overhead
-        datapoint must not queue behind the optional sweep) and again
-        after it if the sweep finds a better batch.  Emits a better final
-        line only if it beats the emitted best and passes validation.
-
-        FLOPs note: XLA cost analysis counts a ``lax.scan`` body ONCE,
-        not × trip count (verified empirically — a scanned matmul chain
-        reports 1/8 of its unrolled FLOPs), so the cycle program's own
-        cost analysis undercounts ~5×.  The cycle's true per-call FLOPs
-        are derived from the four PHASE measurements at the same batch:
-        cadence-weighted per-iteration FLOPs × cycle length."""
-        nonlocal state, best
-        b_cfg = dataclasses.replace(
-            cfg, train=dataclasses.replace(cfg.train, batch_size=bsz))
-        fns = make_train_steps(b_cfg, env, batch_size=bsz)
-        if fns.cycle is None:
-            return
-        k_cyc = fns.cycle_len
-        imgs_k = jax.device_put(
-            np.random.RandomState(0).randint(
-                0, 255, (k_cyc, bsz, res, res, 3), dtype=np.uint8),
-            env.batch_stack())
-        tc = time.time()
-        compiled = fns.cycle.lower(state, imgs_k, rng, 0).compile()
-        c_s = time.time() - tc
-        _, ph_flops = phase_results.get(bsz, ({}, {}))
-        fl = (cadence_weighted(ph_flops, t.d_reg_interval,
-                               t.g_reg_interval) * k_cyc
-              if all(k in ph_flops for k in ("d", "g", "d_r1", "g_pl"))
-              else None)
-        _log(f"[b{bsz}] compiled cycle{k_cyc} in {c_s:.1f}s"
-             + (f" ({fl / 1e12:.3f} TFLOP/call from phase analysis)"
-                if fl else ""))
-        st, sums = compiled(state, imgs_k, rng, 0)   # warm-up
-        jax.block_until_ready(st.step)
-        n_calls = max(2, iters // k_cyc * 2)
-        t0 = time.time()
-        for _ in range(n_calls):
-            st, sums = compiled(st, imgs_k, rng, 0)
-        jax.block_until_ready(st.step)
-        t_block = time.time()
-        float(np.asarray(jax.device_get(
-            jax.tree_util.tree_leaves(sums)[0])).ravel()[0])
-        tail = time.time() - t_block
-        state = st
-        per_call = (t_block - t0) / n_calls
-        per_chip = bsz * k_cyc / per_call / n_chips
-        _log(f"[b{bsz}] timed cycle{k_cyc}: {per_call * 1e3:.1f} ms/cycle "
-             f"= {per_chip:.1f} img/s/chip (sync tail {tail * 1e3:.0f} ms)")
-        out = {
-            "metric": metric,
-            "value": round(per_chip, 2),
-            "unit": "img/sec/chip",
-            "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
-            "method": f"fused_cycle_{k_cyc}",
-            "n_chips": n_chips,
-            "platform": platform,
-            "batch_per_chip": bsz // n_chips,
-            "cycle_ms": round(per_call * 1e3, 2),
-            "fetch_sync_tail_s": {"cycle": round(tail, 3)},
-            "compile_s": {"cycle": round(c_s, 1)},
-            "device": identity,
-        }
-        sus = []
-        if fl:
-            out["cycle_gflops_per_chip"] = round(fl / 1e9, 1)
-            out["cycle_flops_source"] = \
-                "phase cost analysis x cadence (scan bodies count once)"
-            if peak:
-                m = fl / per_call / (peak * 1e12)
-                out["peak_bf16_tflops_per_chip"] = peak
-                out["mfu"] = round(m, 4)
-                if m >= 1.0:
-                    sus.append(
-                        f"mfu {m:.2f} >= 1.0 — implied throughput exceeds "
-                        f"{dev0.device_kind} bf16 peak")
-        if tail > 0.3 * per_call * n_calls + 1.0:
-            sus.append(f"cycle: device_get sync tail {tail:.2f}s after a "
-                       f"{per_call * n_calls:.2f}s timed loop — early acks")
-        if sus:
-            out["suspect"] = sus
-        if per_chip > best and not sus:
-            best = per_chip
-            emit_json(out)
-        else:
-            _log(f"cycle{k_cyc}: {per_chip:.1f} img/s/chip — not better "
-                 f"than {best:.1f} (or suspect), not emitting")
-
-    def run_witness() -> None:
-        """Device-time witness (VERDICT r3 item 1b): trace a short window of
-        the ``d`` phase; the xplane's DEVICE plane records what the chip
-        actually executed — relay acks cannot fake it.  Runs LAST, after
-        every measurement is already emitted: ``jax.profiler.start_trace``
-        was observed to HANG forever over the axon tunnel (r4, 2026-07-31 —
-        an 1800s budget died inside the tracer before any JSON was emitted),
-        and incremental emission means a hang here costs nothing but the
-        witness itself.  On success the final artifact is re-emitted with
-        ``device_trace`` attached (plus a ``suspect`` entry if the device
-        time contradicts the claimed wall).
-
-        OPT-IN (GRAFT_BENCH_TRACE=1): the tracer hang is not just a lost
-        budget — the client killed mid-trace left the tunnel's backend
-        claim WEDGED for every subsequent process for 20+ minutes (r4,
-        observed).  A witness that can poison the shared backend must not
-        run unattended; the sync-tail fetch + linearity probe remain the
-        always-on device-time evidence (VERDICT r3 item 1b's "at minimum"
-        clause)."""
-        nonlocal state
-        if (not on_tpu or profile_dir or not witness_refs or not last_out
-                or os.environ.get("GRAFT_BENCH_TRACE", "0") != "1"):
-            return
-        # Trace the d program of the BATCH THE FINAL ARTIFACT REPORTS, so
-        # the attached evidence always describes the measured config (the
-        # fused-cycle line runs at the best phase-weighted batch, so the
-        # same program matches it too).
-        bsz = int(last_out.get("batch_per_chip", 0)) * n_chips
-        if bsz not in witness_refs:
-            _log(f"trace witness: no d program kept for batch "
-                 f"{bsz // max(n_chips, 1)}/chip — skipping")
-            return
-        import shutil
-        import tempfile
-
-        from gansformer_tpu.utils.benchcheck import trace_suspect
-        from gansformer_tpu.utils.profparse import device_busy_span
-
-        compiled, extra = witness_refs[bsz]
-        t_d = phase_results.get(bsz, ({}, {}))[0].get("d", 0.0)
-        tdir = tempfile.mkdtemp(prefix="graft_bench_trace_")
-        n_tr = min(10, iters)
-        st = state
-        try:
-            _log("trace witness: starting profiler "
-                 "(opt-in; runs last — a tunnel hang here cannot cost "
-                 "any already-emitted result)")
-            jax.profiler.start_trace(tdir)
-            try:
-                t0_tr = time.time()
-                for _ in range(n_tr):
-                    st, _ = compiled(st, *extra)
-                jax.block_until_ready(st.step)
-                wall_tr = time.time() - t0_tr
-            finally:
-                jax.profiler.stop_trace()
-            state = st
-            dev = device_busy_span(tdir)
-            if not dev:
-                _log("trace witness: no parseable device plane (non-fatal)")
-                return
-            busy, span, plane = dev
-            tc = {"busy_s": round(busy, 4), "span_s": round(span, 4),
-                  "wall_s": round(wall_tr, 4), "iters": n_tr, "plane": plane}
-            _log(f"trace witness: device busy {busy * 1e3:.1f} ms over "
-                 f"{n_tr} iters (wall {wall_tr * 1e3:.1f} ms, plane {plane})")
-            if last_out:
-                out = dict(last_out)
-                out["device_trace"] = tc
-                ts = trace_suspect(busy, wall_tr, n_tr, t_d)
-                if ts:
-                    out["suspect"] = out.get("suspect", []) + [ts]
-                emit_json(out)
-        except Exception as e:
-            _log(f"trace witness failed (non-fatal): "
-                 f"{type(e).__name__}: {str(e)[:200]}")
-        finally:
-            shutil.rmtree(tdir, ignore_errors=True)
-
-    def note_oom(msg: str) -> None:
-        """Append (never overwrite) the OOM record in the final artifact."""
-        sweep_notes.append(msg)
-        if last_out:
-            last_out["sweep_stopped"] = list(sweep_notes)
-            print(json.dumps(last_out), flush=True)
-
-    oom_per_chip = None   # smallest per-chip batch known to OOM
+    best_phase = 0.0    # best PHASE-WEIGHTED result (sweep tracking — the
+    #                     cycle number must not hide a better batch)
+    best_bsz = 0        # global batch of the best phase-weighted result
+    oom_per_chip = None  # smallest per-chip batch known to OOM
 
     try:
         try:
-            best = best_phase = measure(batch, emit_only_if_better=False)
+            sess.best = best_phase = sess.measure(
+                batch, emit_only_if_better=False)
             best_bsz = batch
         except Exception as e:
             # OOM at the default batch: halve once instead of dying with
@@ -555,48 +687,16 @@ def _run_inner() -> None:
             batch = half
             # The failed measure() donated the old state's buffers into the
             # aborted execution — rebuild before retrying.
-            state = fresh_state()
-            best = best_phase = measure(batch, emit_only_if_better=False)
+            sess.state = sess.fresh_state()
+            sess.best = best_phase = sess.measure(
+                batch, emit_only_if_better=False)
             best_bsz = batch
-            note_oom(f"oom at default batch {oom_per_chip}/chip; "
-                     f"fell back to {batch // n_chips}/chip")
+            sess.note_oom(f"oom at default batch {oom_per_chip}/chip; "
+                          f"fell back to {batch // n_chips}/chip")
 
         cycle_on = (on_tpu and
                     os.environ.get("GRAFT_BENCH_CYCLE", "1") != "0")
-        cycle_oom_bsz = None    # smallest global batch whose CYCLE OOMed
         budget = float(os.environ.get("GRAFT_BENCH_TPU_TIMEOUT", "900"))
-
-        def try_cycle(bsz: int, label: str) -> None:
-            """measure_cycle as a best-effort extra: an OOM or any other
-            cycle-only failure is recorded in the artifact and must never
-            cost the remaining measurements (the cycle program is a scan
-            the four phase programs don't exercise — a lowering bug there
-            should not kill the sweep)."""
-            nonlocal state, cycle_oom_bsz
-            if cycle_oom_bsz is not None and bsz >= cycle_oom_bsz:
-                _log(f"cycle: skipping batch {bsz // n_chips}/chip "
-                     f"(>= known cycle OOM at {cycle_oom_bsz // n_chips}"
-                     f"/chip)")
-                return
-            if time.time() - _T0 > budget - 180:
-                _log(f"cycle ({label}): skipping (outer budget nearly "
-                     f"spent)")
-                return
-            try:
-                measure_cycle(bsz)
-            except Exception as e:
-                if _is_oom(e):
-                    cycle_oom_bsz = min(bsz, cycle_oom_bsz or bsz)
-                    note_oom(f"cycle oom at batch {bsz // n_chips}/chip "
-                             f"({label}; stacked input adds "
-                             f"{cfg.train.d_reg_interval}x batch of uint8)")
-                else:
-                    _log(f"cycle ({label}) failed (non-fatal): "
-                         f"{type(e).__name__}: {str(e)[:300]}")
-                    sweep_notes.append(
-                        f"cycle failed at batch {bsz // n_chips}/chip: "
-                        f"{type(e).__name__}")
-                state = fresh_state()   # buffers were donated & lost
 
         # Fused-cycle at the default batch FIRST (before the compile-heavy
         # sweep): one dispatch per 16 iterations is the number that shows
@@ -604,7 +704,7 @@ def _run_inner() -> None:
         # result, and tunnel windows have died mid-sweep before (r4) — the
         # most informative datapoint must not queue behind the optional one.
         if cycle_on and best_bsz:
-            try_cycle(best_bsz, "pre-sweep")
+            sess.try_cycle(best_bsz, "pre-sweep", budget)
 
         # Batch sweep (TPU only): larger per-chip batches usually feed the
         # MXU better; try each while the outer budget allows, emitting only
@@ -624,11 +724,11 @@ def _run_inner() -> None:
                          f"(outer budget nearly spent)")
                     break
                 try:
-                    r = measure(per_chip_b * n_chips,
-                                emit_only_if_better=True)
+                    r = sess.measure(per_chip_b * n_chips,
+                                     emit_only_if_better=True)
                     if r > best_phase:
                         best_phase, best_bsz = r, per_chip_b * n_chips
-                    best = max(best, r)
+                    sess.best = max(sess.best, r)
                 except Exception as e:
                     if not _is_oom(e):
                         raise
@@ -636,9 +736,9 @@ def _run_inner() -> None:
                     # dying silently after the budget is spent.
                     oom_per_chip = min(per_chip_b, oom_per_chip or per_chip_b)
                     _log(f"sweep: OOM at batch {per_chip_b}/chip")
-                    if last_out:
-                        note_oom(f"oom at batch {per_chip_b}/chip")
-                    state = fresh_state()   # buffers were donated & lost
+                    if sess.last_out:
+                        sess.note_oom(f"oom at batch {per_chip_b}/chip")
+                    sess.state = sess.fresh_state()  # buffers donated & lost
 
         # Re-measure the fused cycle at the sweep's winning batch when the
         # sweep found a better config than the pre-sweep cycle already
@@ -647,10 +747,10 @@ def _run_inner() -> None:
         # skips (one cycle call costs ~16 proxy iterations and would blow
         # the 270s fallback budget).
         if cycle_on and best_bsz and best_bsz != batch:
-            try_cycle(best_bsz, "post-sweep")
+            sess.try_cycle(best_bsz, "post-sweep", budget)
 
         # Absolute last: the profiler witness (can hang over the tunnel).
-        run_witness()
+        sess.run_witness()
     finally:
         if profile_dir:
             jax.profiler.stop_trace()

@@ -1,0 +1,121 @@
+"""Seam tests for bench.py's pure artifact builders (VERDICT r4 weak #4:
+the logic that decides whether a number is real must be unit-testable).
+
+``build_phase_artifact`` / ``build_cycle_artifact`` are pure functions on
+plain dicts — no device, no jax — so these tests pin the exact artifact
+schema (PERF.md §4) and the suspect-flagging behavior the judge reads."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (repo-root module; stdlib-only at import time)
+
+IDENTITY = {"device_kind": "TPU v5 lite", "platform": "tpu", "n_devices": 1,
+            "local_device_count": 1, "process_count": 1}
+
+# A physically consistent v5e measurement: 4 phases whose times track
+# their FLOPs at ~33% MFU (the r4 interim datapoint's regime).
+PEAK = 197.0
+FLOPS = {"d": 1.887e12, "g": 1.712e12, "d_r1": 3.129e12, "g_pl": 2.938e12}
+TIMES = {k: v / (0.33 * PEAK * 1e12) for k, v in FLOPS.items()}
+
+
+def phase_kwargs(**over):
+    kw = dict(metric="train_img_per_sec_per_chip_ffhq256_duplex",
+              on_tpu=True, n_chips=1, platform="tpu", bsz=8,
+              timings=dict(TIMES), flops=dict(FLOPS),
+              fetch_s={k: 0.001 for k in TIMES},
+              compile_s={k: 10.0 for k in TIMES},
+              identity=IDENTITY, peak=PEAK, d_reg_interval=16,
+              g_reg_interval=4, iters=20,
+              linearity={"d": (TIMES["d"], TIMES["d"] * 1.02)},
+              device_kind="TPU v5 lite", partial=False)
+    kw.update(over)
+    return kw
+
+
+def test_phase_artifact_clean_measurement():
+    out = bench.build_phase_artifact(**phase_kwargs())
+    assert "suspect" not in out and "partial" not in out
+    assert out["unit"] == "img/sec/chip"
+    # cadence-weighted throughput: batch / weighted-time; MFU ≈ the 33%
+    # the synthetic times encode
+    assert out["value"] == pytest.approx(
+        8 / (TIMES["d"] * 15 / 16 + TIMES["d_r1"] / 16
+             + TIMES["g"] * 3 / 4 + TIMES["g_pl"] / 4), rel=1e-3)
+    assert out["mfu"] == pytest.approx(0.33, abs=0.005)
+    assert out["vs_baseline"] == pytest.approx(out["value"] / 200.0, rel=1e-3)
+    assert set(out["phase_ms"]) == set(TIMES)
+    assert out["device"] is IDENTITY
+
+
+def test_phase_artifact_flags_faster_than_physics():
+    # 10x-too-fast times → implied MFU > 1 → must carry ``suspect``
+    fast = {k: v / 10 for k, v in TIMES.items()}
+    out = bench.build_phase_artifact(**phase_kwargs(
+        timings=fast, linearity={"d": (fast["d"], fast["d"])}))
+    assert any("mfu" in s or "peak" in s for s in out["suspect"])
+
+
+def test_phase_artifact_partial_label_and_reg_approximation():
+    # only the steady-state pair timed: labeled partial, no weighted mfu
+    pair_t = {k: TIMES[k] for k in ("d", "g")}
+    pair_f = {k: FLOPS[k] for k in ("d", "g")}
+    out = bench.build_phase_artifact(**phase_kwargs(
+        timings=pair_t, flops=pair_f,
+        fetch_s={k: 0.001 for k in pair_t},
+        compile_s={k: 10.0 for k in pair_t}, linearity={}, partial=True))
+    assert out["partial"] == "reg variants not yet measured"
+    # the partial estimate approximates reg phases with plain ones —
+    # systematically high vs the full measurement
+    full = bench.build_phase_artifact(**phase_kwargs())
+    assert out["value"] > full["value"]
+
+
+def test_phase_artifact_cpu_proxy_has_null_ratio():
+    out = bench.build_phase_artifact(**phase_kwargs(
+        on_tpu=False, peak=None, metric="train_img_per_sec_per_chip_cpu_proxy"))
+    assert out["vs_baseline"] is None
+    assert "cpu proxy" in out["vs_baseline_note"]
+    assert "mfu" not in out
+
+
+def test_cycle_artifact_clean_and_mfu():
+    k_cyc = 16
+    fl_it = sum(f * w for f, w in (
+        (FLOPS["d"], 15 / 16), (FLOPS["d_r1"], 1 / 16),
+        (FLOPS["g"], 3 / 4), (FLOPS["g_pl"], 1 / 4)))
+    per_call = fl_it * k_cyc / (0.35 * PEAK * 1e12)
+    out = bench.build_cycle_artifact(
+        metric="m", n_chips=1, platform="tpu", bsz=8, k_cyc=k_cyc,
+        per_call_s=per_call, tail_s=0.001, n_calls=4, compile_s=30.0,
+        identity=IDENTITY, peak=PEAK, cycle_flops=fl_it * k_cyc,
+        device_kind="TPU v5 lite")
+    assert "suspect" not in out
+    assert out["method"] == "fused_cycle_16"
+    assert out["mfu"] == pytest.approx(0.35, abs=0.005)
+    assert out["value"] == pytest.approx(8 * k_cyc / per_call, rel=1e-3)
+    assert out["cycle_flops_source"].startswith("phase cost analysis")
+
+
+def test_cycle_artifact_flags_early_ack_tail():
+    # sync tail comparable to the whole timed loop = the block clock lied
+    out = bench.build_cycle_artifact(
+        metric="m", n_chips=1, platform="tpu", bsz=8, k_cyc=16,
+        per_call_s=0.5, tail_s=2.5, n_calls=4, compile_s=30.0,
+        identity=IDENTITY, peak=PEAK, cycle_flops=None,
+        device_kind="TPU v5 lite")
+    assert any("early acks" in s for s in out["suspect"])
+
+
+def test_cycle_artifact_flags_faster_than_physics():
+    out = bench.build_cycle_artifact(
+        metric="m", n_chips=1, platform="tpu", bsz=8, k_cyc=16,
+        per_call_s=1e-4, tail_s=0.0, n_calls=4, compile_s=30.0,
+        identity=IDENTITY, peak=PEAK, cycle_flops=6.4e13,
+        device_kind="TPU v5 lite")
+    assert any(">= 1.0" in s for s in out["suspect"])
