@@ -1,0 +1,73 @@
+"""Tests for the learning-trend checker (VERDICT r4 item 4): the tool
+that turns 'FID went down' from prose into an assertable property of a
+run dir's recorded artifacts."""
+
+import importlib.util
+import json
+import os
+import sys
+
+_spec = importlib.util.spec_from_file_location(
+    "check_learning_trend",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_learning_trend.py"))
+clt = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(clt)
+
+
+def write_run(tmp_path, values, losses=None, name="fid512_uncal"):
+    d = str(tmp_path / "run")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"metric-{name}.txt"), "w") as f:
+        for i, v in enumerate(values):
+            f.write(f"kimg {2.0 * (i + 1):<10.1f} {name} {v:.6f}\n")
+    with open(os.path.join(d, "stats.jsonl"), "w") as f:
+        for i, l in enumerate(losses or [1.0] * len(values)):
+            f.write(json.dumps({"Progress/tick": i, "Loss/D": l,
+                                "Loss/G": 0.5}) + "\n")
+    return d
+
+def test_decreasing_fid_passes(tmp_path):
+    d = write_run(tmp_path, [320.0, 260.0, 210.0, 190.0])
+    out = clt.check(d, None, min_points=3, min_drop=0.10)
+    assert out["ok"], out
+    assert out["metric"] == "fid512_uncal"
+    assert out["points"] == 4 and out["fit_drop_rel"] > 0.3
+
+
+def test_flat_fid_fails(tmp_path):
+    d = write_run(tmp_path, [300.0, 298.0, 301.0, 299.0])
+    out = clt.check(d, None, min_points=3, min_drop=0.10)
+    assert not out["ok"] and "no learning evidence" in out["error"]
+
+
+def test_noisy_last_tick_cannot_fake_trend(tmp_path):
+    # rising overall; a lucky final dip must not pass the fitted check
+    d = write_run(tmp_path, [200.0, 240.0, 280.0, 180.0])
+    out = clt.check(d, None, min_points=3, min_drop=0.10)
+    assert not out["ok"]
+
+
+def test_too_few_points_fails(tmp_path):
+    d = write_run(tmp_path, [300.0, 200.0])
+    out = clt.check(d, None, min_points=3, min_drop=0.10)
+    assert not out["ok"] and "metric points" in out["error"]
+
+
+def test_nonfinite_loss_fails(tmp_path):
+    d = write_run(tmp_path, [320.0, 260.0, 210.0],
+                  losses=[1.0, float("nan"), 1.0])
+    out = clt.check(d, None, min_points=3, min_drop=0.10)
+    assert not out["ok"] and "non-finite" in out["error"]
+
+
+def test_cli_exit_codes(tmp_path):
+    import subprocess
+
+    d = write_run(tmp_path, [320.0, 260.0, 210.0, 190.0])
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "check_learning_trend.py")
+    r = subprocess.run([sys.executable, script, d], capture_output=True,
+                       text=True)
+    assert r.returncode == 0 and json.loads(r.stdout)["ok"]
