@@ -18,7 +18,9 @@ def main(argv=None) -> None:
     p.add_argument("--num-images", type=int, default=None,
                    help="override metric sample count (e.g. 1000 for smoke)")
     p.add_argument("--batch-size", type=int, default=32)
-    p.add_argument("--truncation-psi", type=float, default=1.0)
+    # None default (ADVICE r4): ANY explicit value — including 1.0 — must
+    # conflict with --psi-sweep; unset falls back to 1.0 below.
+    p.add_argument("--truncation-psi", type=float, default=None)
     p.add_argument("--psi-sweep", default=None,
                    help="comma-separated truncation values (e.g. "
                         "'0.5,0.7,1.0'): run the metrics once per psi and "
@@ -46,9 +48,11 @@ def main(argv=None) -> None:
                     f"{args.psi_sweep!r}")
         if not psis:
             p.error("--psi-sweep: no values given")
-        if args.truncation_psi != 1.0:
+        if args.truncation_psi is not None:
             p.error("--truncation-psi conflicts with --psi-sweep; put the "
                     "value in the sweep list instead")
+    if args.truncation_psi is None:
+        args.truncation_psi = 1.0
 
     from gansformer_tpu.core.config import ExperimentConfig
     from gansformer_tpu.train import checkpoint as ckpt
