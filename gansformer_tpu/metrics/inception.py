@@ -187,6 +187,23 @@ class FeatureExtractor:
             self.net = InceptionV3()
             params = self.net.init(
                 jax.random.PRNGKey(seed), jnp.zeros((1, 299, 299, 3)))["params"]
+            # Uncalibrated regime fixes (r5 — FID_uncal measured ~1e-4 for
+            # ANY pair of distributions, making 'FID fell' unobservable):
+            #
+            # 1. He-rescale every kernel (lecun init loses the ReLU's √2
+            #    per layer; through ~100 convs the signal decayed to ~1e-4
+            #    absolute scale, and FID scales QUADRATICALLY with feature
+            #    scale).
+            # 2. Standardize features/logits per-dim against a fixed
+            #    multi-scale noise probe, so the random-projection FID
+            #    lands in an O(1..1e3) readable range and IS_uncal's
+            #    softmax sees O(1) logit spread.  Deterministic (seeded
+            #    probe), dataset-independent, applied ONLY when
+            #    uncalibrated; per-dim affine scaling preserves exactly
+            #    the two-sample-discrepancy property the docstring claims.
+            params = jax.tree_util.tree_map_with_path(
+                lambda path, x: x * np.sqrt(2.0)
+                if path[-1].key == "kernel" else x, params)
             self.calibrated = False
         else:
             # class count follows the checkpoint: 1008 for the reference's
@@ -195,11 +212,62 @@ class FeatureExtractor:
             self.net = InceptionV3(num_classes=num_classes)
             self.calibrated = True
         self.env = env
+        raw_apply = jax.jit(
+            lambda p, x: self.net.apply({"params": p}, preprocess(x)))
+        if not self.calibrated:
+            # Scales are computed BEFORE the mesh device_put below, on the
+            # process-local default device: mixing global-mesh params with
+            # a local probe array (or reducing a non-fully-addressable
+            # output eagerly) would crash every multi-host uncalibrated
+            # sweep at construction.  Deterministic per seed, so every
+            # process computes identical scales — the cross-host
+            # calibration agreement check guards any drift.
+            f_scale, l_scale = self._probe_scales(raw_apply, params, seed)
         if env is not None:
             params = jax.device_put(params, env.replicated())
         self.params = params
-        self._apply = jax.jit(
-            lambda p, x: self.net.apply({"params": p}, preprocess(x)))
+        if self.calibrated:
+            self._apply = raw_apply
+        else:
+            self._apply = jax.jit(
+                lambda p, x: tuple(
+                    o * s for o, s in zip(raw_apply(p, x),
+                                          (f_scale, l_scale))))
+
+    # seed -> (f_scale, l_scale): the probe forward costs a full Inception
+    # compile+run; it is a pure function of the seed, so pay it once per
+    # process, not once per FeatureExtractor (CI builds several).
+    _PROBE_MEMO: dict = {}
+
+    @classmethod
+    def _probe_scales(cls, raw_apply, params, seed: int):
+        """Per-dim 1/std of features and logits over a fixed 16-image
+        multi-scale noise probe (coarse 8² + mid 32² + fine 299² Gaussian
+        pyramids) — spans low- and high-frequency content so no probe-dead
+        feature dim gets a huge scale by accident; floored at 1e-3 of the
+        per-tensor median std so genuinely dead dims stay quiet."""
+        if seed in cls._PROBE_MEMO:
+            return cls._PROBE_MEMO[seed]
+        k = jax.random.PRNGKey(seed + 1)
+        k1, k2, k3 = jax.random.split(k, 3)
+        n = 16
+
+        def up(key, r):
+            z = jax.random.normal(key, (n, r, r, 3), jnp.float32)
+            return jax.image.resize(z, (n, 299, 299, 3), "bilinear")
+
+        probe = jnp.tanh(up(k1, 8) + 0.5 * up(k2, 32)
+                         + 0.25 * jax.random.normal(
+                             k3, (n, 299, 299, 3), jnp.float32))
+        feats, logits = raw_apply(params, probe)
+
+        def scale(t):
+            s = jnp.std(t, axis=0)
+            floor = 1e-3 * jnp.median(s) + 1e-20
+            return 1.0 / jnp.maximum(s, floor)
+
+        cls._PROBE_MEMO[seed] = (scale(feats), scale(logits))
+        return cls._PROBE_MEMO[seed]
 
     def __call__(self, images: jax.Array):
         """(features, logits) for ``images``.

@@ -73,8 +73,11 @@ def _real_stats(dataset: Dataset, extractor: FeatureExtractor,
     per-dataset activation pickles."""
     key = None
     if cache_dir:
+        # 'rand2' (not 'rand'): the r5 uncalibrated-extractor fix (He
+        # rescale + probe standardization, inception.py) changes every
+        # random-regime feature — a pre-fix cached μ/Σ must not be reused.
         tag = f"{dataset.cache_tag()}-{num_images}-" \
-              f"{'cal' if extractor.calibrated else 'rand'}"
+              f"{'cal' if extractor.calibrated else 'rand2'}"
         key = os.path.join(
             cache_dir, "real-stats-" +
             hashlib.md5(tag.encode()).hexdigest()[:16] + ".npz")
