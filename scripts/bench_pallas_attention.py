@@ -19,7 +19,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def bench_one(res: int, k: int, batch: int, heads: int, iters: int) -> dict:
+def bench_one(res: int, k: int, batch: int, heads: int, iters: int,
+              direction: str) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -33,10 +34,17 @@ def bench_one(res: int, k: int, batch: int, heads: int, iters: int) -> dict:
     n = res * res
     dtype = jnp.bfloat16
     rs = np.random.RandomState(0)
-    # grid→latent direction (the main phase): q from grid, k/v from latents
-    q = jnp.asarray(rs.randn(batch, n, c), dtype)
-    kk = jnp.asarray(rs.randn(batch, k, c), dtype)
-    v = jnp.asarray(rs.randn(batch, k, c), dtype)
+    if direction == "grid_to_latent":
+        # the main simplex/duplex phase: q from the grid, k/v from latents
+        q = jnp.asarray(rs.randn(batch, n, c), dtype)
+        kk = jnp.asarray(rs.randn(batch, k, c), dtype)
+        v = jnp.asarray(rs.randn(batch, k, c), dtype)
+    else:
+        # duplex back-direction: q from latents, softmax over the n-grid —
+        # the blockwise flash kernel (online softmax; the 1024² VMEM case)
+        q = jnp.asarray(rs.randn(batch, k, c), dtype)
+        kk = jnp.asarray(rs.randn(batch, n, c), dtype)
+        v = jnp.asarray(rs.randn(batch, n, c), dtype)
     interpret = jax.default_backend() != "tpu"
 
     fns = {
@@ -44,8 +52,8 @@ def bench_one(res: int, k: int, batch: int, heads: int, iters: int) -> dict:
         "pallas": jax.jit(lambda q, kk, v: multihead_attention_pallas(
             q, kk, v, heads, interpret=interpret)),
     }
-    out = {"res": res, "n": n, "c": c, "k": k, "batch": batch,
-           "backend": jax.default_backend()}
+    out = {"direction": direction, "res": res, "n": n, "c": c, "k": k,
+           "batch": batch, "backend": jax.default_backend()}
     ref = None
     for name, fn in fns.items():
         r = fn(q, kk, v)
@@ -73,9 +81,33 @@ def main() -> None:
     p.add_argument("--k", type=int, default=16)
     p.add_argument("--heads", type=int, default=1)
     args = p.parse_args()
+
+    import jax
+
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
+
+    enable_compile_cache()
+
+    # First line: the native-Mosaic reality record (VERDICT r4 item 4).
+    # On a TPU this compiles BOTH kernels natively at the gate's shapes and
+    # reports max_abs_diff vs the jnp oracle — the recorded artifact the
+    # runtime ``resolve_backend`` gate otherwise only produces transiently.
+    dev = jax.devices()[0]
+    head = {"device_kind": dev.device_kind, "platform": dev.platform}
+    if dev.platform == "tpu":
+        from gansformer_tpu.ops.pallas_attention import tpu_smoke_check
+
+        ok, detail = tpu_smoke_check()
+        head["tpu_smoke_check"] = {"ok": ok, "detail": detail}
+    else:
+        head["note"] = ("non-TPU backend: pallas runs in interpret mode; "
+                        "no native Mosaic evidence from this run")
+    print(json.dumps(head), flush=True)
+
     for res in args.res:
-        print(json.dumps(bench_one(res, args.k, args.batch, args.heads,
-                                   args.iters)), flush=True)
+        for direction in ("grid_to_latent", "latent_to_grid"):
+            print(json.dumps(bench_one(res, args.k, args.batch, args.heads,
+                                       args.iters, direction)), flush=True)
 
 
 if __name__ == "__main__":
