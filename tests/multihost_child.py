@@ -61,6 +61,19 @@ def main() -> None:
     batch = jax.make_array_from_process_local_data(env.batch(), imgs_local)
     assert batch.shape[0] == global_batch
 
+    # Rendezvous BEFORE the first device computation: the first dispatch
+    # creates the gloo clique, whose key-value exchange carries a hard 30 s
+    # deadline — far less than the import/trace skew two children can
+    # accumulate on a loaded single-core host (observed r5: DEADLINE_
+    # EXCEEDED flakes whenever a background run shares the box).  The
+    # coordinator's KV barrier has a configurable timeout, so both
+    # processes arrive here at leisure and then dispatch within
+    # milliseconds of each other.
+    from jax._src import distributed
+
+    distributed.global_state.client.wait_at_barrier(
+        "child_imports_done", timeout_in_ms=600_000)
+
     with env.activate():   # ambient mesh for the SP grid constraints
         state = create_train_state(cfg, jax.random.PRNGKey(0))
         state = jax.device_put(state, env.replicated())

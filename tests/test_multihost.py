@@ -22,32 +22,63 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_sharded_train_step(tmp_path):
+def _spawn_children(tmp_path):
+    """Run the 2-process child pair to completion; returns on success.
+
+    One bounded retry for gloo's clique-formation DEADLINE_EXCEEDED: the
+    clique's key-value exchange carries a hard 30 s deadline inside XLA,
+    while two children on a loaded single-core host can accumulate more
+    than that in compile/trace skew before their first collective (the
+    child's pre-dispatch KV barrier shrinks the skew but cannot bound the
+    post-barrier compiles).  The retry is gated on that exact signature so
+    a real failure — assertion, crash, lockstep divergence — still fails
+    immediately; a second DEADLINE_EXCEEDED fails the test.
+    """
     from gansformer_tpu.utils.hostenv import sanitized_cpu_env
 
-    port = _free_port()
     child = os.path.join(os.path.dirname(__file__), "multihost_child.py")
     env = sanitized_cpu_env(4)     # 4 virtual CPU devices per process
     # cross-process CPU collectives ride gloo (the CPU stand-in for ICI)
     env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, child, str(port), str(pid), str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True, cwd=os.path.dirname(os.path.dirname(child)))
-        for pid in (0, 1)]
-    try:
-        outs = [p.communicate(timeout=1500) for p in procs]
-    except subprocess.TimeoutExpired:
-        for p in procs:      # never leak gloo-connected children
-            p.kill()
-        raise
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"child failed:\n{out}\n{err[-3000:]}"
+    for attempt in (0, 1):
+        port = _free_port()
+        # Fresh out-dir per attempt: a retry after a mid-run infra failure
+        # must not inherit attempt 0's stats/checkpoints (stale artifacts
+        # could satisfy the callers' assertions).
+        out_dir = tmp_path / f"a{attempt}"
+        out_dir.mkdir(exist_ok=True)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, child, str(port), str(pid), str(out_dir)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, cwd=os.path.dirname(os.path.dirname(child)))
+            for pid in (0, 1)]
+        try:
+            outs = [p.communicate(timeout=1500) for p in procs]
+        except subprocess.TimeoutExpired:
+            for p in procs:      # never leak gloo-connected children
+                p.kill()
+            raise
+        rcs = [p.returncode for p in procs]
+        if all(rc == 0 for rc in rcs):
+            return out_dir
+        infra = any("DEADLINE_EXCEEDED" in err and "gloo" in (out + err)
+                    for out, err in outs)
+        if attempt == 0 and infra:
+            print("gloo clique rendezvous hit its 30s deadline "
+                  "(host-load skew); retrying the child pair once",
+                  file=sys.stderr)
+            continue
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"child failed:\n{out}\n{err[-3000:]}"
+
+
+def test_two_process_sharded_train_step(tmp_path):
+    out_dir = _spawn_children(tmp_path)
 
     results = []
     for pid in (0, 1):
-        with open(tmp_path / f"p{pid}.json") as f:
+        with open(out_dir / f"p{pid}.json") as f:
             results.append(json.load(f))
     r0, r1 = results
     assert r0["lbs"] == r1["lbs"] == 8          # 16 global / 2 processes
