@@ -61,24 +61,32 @@ def main() -> None:
     batch = jax.make_array_from_process_local_data(env.batch(), imgs_local)
     assert batch.shape[0] == global_batch
 
-    # Rendezvous BEFORE the first device computation: the first dispatch
-    # creates the gloo clique, whose key-value exchange carries a hard 30 s
-    # deadline — far less than the import/trace skew two children can
-    # accumulate on a loaded single-core host (observed r5: DEADLINE_
-    # EXCEEDED flakes whenever a background run shares the box).  The
-    # coordinator's KV barrier has a configurable timeout, so both
-    # processes arrive here at leisure and then dispatch within
-    # milliseconds of each other.
-    from jax._src import distributed
-
-    distributed.global_state.client.wait_at_barrier(
-        "child_imports_done", timeout_in_ms=600_000)
-
     with env.activate():   # ambient mesh for the SP grid constraints
         state = create_train_state(cfg, jax.random.PRNGKey(0))
         state = jax.device_put(state, env.replicated())
         fns = make_train_steps(cfg, env, batch_size=global_batch)
-        state, aux = fns.d_step(state, batch, jax.random.PRNGKey(1))
+        # AOT-compile the first collective programs, THEN rendezvous, THEN
+        # dispatch: the first dispatch forms the gloo clique, whose
+        # key-value exchange carries a hard 30 s deadline inside XLA — far
+        # less than the import/trace/COMPILE skew two children can
+        # accumulate on a loaded single-core host (observed r5: DEADLINE_
+        # EXCEEDED flakes whenever a background run shares the box).  With
+        # the compiles paid up front and the coordinator's KV barrier
+        # (configurable timeout) crossed after them, both processes reach
+        # the clique formation within milliseconds of each other.
+        # Only the FIRST program is AOT'd: its dispatch forms the clique;
+        # g_step's jit call happens after the clique exists, and AOT-ing
+        # it too would require matching the d-output's propagated
+        # shardings exactly (AOT calls don't auto-reshard).
+        d_exec = fns.d_step.lower(
+            state, batch, jax.random.PRNGKey(1)).compile()
+
+        from jax._src import distributed
+
+        distributed.global_state.client.wait_at_barrier(
+            "child_precompiled", timeout_in_ms=600_000)
+
+        state, aux = d_exec(state, batch, jax.random.PRNGKey(1))
         state, g_aux = fns.g_step(state, jax.random.PRNGKey(2))
         jax.block_until_ready(state.step)
 

@@ -25,14 +25,15 @@ def _free_port() -> int:
 def _spawn_children(tmp_path):
     """Run the 2-process child pair to completion; returns on success.
 
-    One bounded retry for gloo's clique-formation DEADLINE_EXCEEDED: the
+    Bounded retries for gloo's clique-formation DEADLINE_EXCEEDED: the
     clique's key-value exchange carries a hard 30 s deadline inside XLA,
-    while two children on a loaded single-core host can accumulate more
-    than that in compile/trace skew before their first collective (the
-    child's pre-dispatch KV barrier shrinks the skew but cannot bound the
-    post-barrier compiles).  The retry is gated on that exact signature so
-    a real failure — assertion, crash, lockstep divergence — still fails
-    immediately; a second DEADLINE_EXCEEDED fails the test.
+    and the 8 virtual ranks timeshare one physical core — under external
+    host load the ranks' pre-collective execution skew alone can exceed
+    30 s, regardless of the child's AOT compiles and pre-dispatch KV
+    barrier (which remove the compile/trace component of the skew).  The
+    retries are gated on that exact signature so a real failure —
+    assertion, crash, lockstep divergence — still fails immediately; on
+    an otherwise-idle host the first attempt passes (verified r5).
     """
     from gansformer_tpu.utils.hostenv import sanitized_cpu_env
 
@@ -40,7 +41,7 @@ def _spawn_children(tmp_path):
     env = sanitized_cpu_env(4)     # 4 virtual CPU devices per process
     # cross-process CPU collectives ride gloo (the CPU stand-in for ICI)
     env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
-    for attempt in (0, 1):
+    for attempt in (0, 1, 2):
         port = _free_port()
         # Fresh out-dir per attempt: a retry after a mid-run infra failure
         # must not inherit attempt 0's stats/checkpoints (stale artifacts
@@ -64,9 +65,9 @@ def _spawn_children(tmp_path):
             return out_dir
         infra = any("DEADLINE_EXCEEDED" in err and "gloo" in (out + err)
                     for out, err in outs)
-        if attempt == 0 and infra:
+        if attempt < 2 and infra:
             print("gloo clique rendezvous hit its 30s deadline "
-                  "(host-load skew); retrying the child pair once",
+                  "(host-load skew); retrying the child pair",
                   file=sys.stderr)
             continue
         for p, (out, err) in zip(procs, outs):
