@@ -418,3 +418,35 @@ def test_preprocess_resize_matches_tf_golden():
             err_msg=f"resize semantics drifted vs TF golden at {res}^2")
         assert abs(got.mean() - golden[f"mean_{res}"]) < 1e-5
         assert abs(got.std() - golden[f"std_{res}"]) < 1e-5
+
+
+def test_uncalibrated_extractor_discriminates():
+    """Regression guard for the r5 uncalibrated-regime fix: random
+    lecun-init features had collapsed to ~1e-4 scale (FID_uncal ~1e-4 for
+    ANY pair of distributions — 'FID fell' was unobservable).  With the
+    He rescale + probe standardization, features must have O(1) per-dim
+    spread and the Frechet distance between clearly different
+    distributions must dwarf the same-distribution sampling floor."""
+    from gansformer_tpu.metrics.fid import (compute_activation_stats,
+                                            frechet_distance)
+    from gansformer_tpu.metrics.inception import FeatureExtractor
+
+    ex = FeatureExtractor(None)
+    rs = np.random.RandomState(0)
+    noise_a = jnp.asarray(rs.rand(16, 64, 64, 3) * 2 - 1, jnp.float32)
+    noise_b = jnp.asarray(rs.rand(16, 64, 64, 3) * 2 - 1, jnp.float32)
+    yy, xx = np.mgrid[0:64, 0:64] / 64.0
+    grads = jnp.asarray(np.stack(
+        [np.stack([yy * s, xx, yy * xx], -1)
+         for s in np.linspace(0.2, 1.0, 16)]) * 2 - 1, jnp.float32)
+
+    fa, _ = ex(noise_a)
+    fb, _ = ex(noise_b)
+    fc, _ = ex(grads)
+    fa, fb, fc = map(np.asarray, (fa, fb, fc))
+    assert fa.std(0).mean() > 0.01, "features collapsed again"
+    fid_same = frechet_distance(*compute_activation_stats(fa),
+                                *compute_activation_stats(fb))
+    fid_diff = frechet_distance(*compute_activation_stats(fa),
+                                *compute_activation_stats(fc))
+    assert fid_diff > 20 * fid_same, (fid_same, fid_diff)
