@@ -1,0 +1,80 @@
+"""Harvest the learning-evidence run into a committed record.
+
+Copies the PROOF artifacts of a training run (VERDICT r4 item 4: the
+reference's golden-metric verification model — watch FID fall) into
+``docs/learning_evidence_<tag>/``: stats.jsonl, every metric series, the
+resolved config, first/latest image grids, a grid of REAL samples from
+the same dataset for side-by-side reading, and the
+``check_learning_trend`` verdict as JSON.  Exits non-zero if the trend
+check fails — a harvest that can't prove learning should not look like
+one that did.
+
+  PYTHONPATH= JAX_PLATFORMS=cpu python scripts/harvest_learning_run.py \
+      .learning_run/00000-learn-evidence --tag r05
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from check_learning_trend import check  # noqa: E402  (sibling script)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("run_dir")
+    p.add_argument("--tag", default="r05")
+    p.add_argument("--min-points", type=int, default=3)
+    p.add_argument("--min-drop", type=float, default=0.10)
+    args = p.parse_args()
+    run = args.run_dir.rstrip("/")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = os.path.join(repo, "docs", f"learning_evidence_{args.tag}")
+    os.makedirs(out, exist_ok=True)
+
+    verdict = check(run, None, args.min_points, args.min_drop)
+    print(json.dumps(verdict))
+    if not verdict["ok"]:
+        # Do NOT touch the committed evidence dir: a failing re-harvest
+        # must never clobber a passing verdict with a contradiction.
+        sys.exit(1)
+    with open(os.path.join(out, "trend.json"), "w") as f:
+        json.dump(verdict, f, indent=1)
+
+    for name in ["stats.jsonl", "config.json", "log.txt"]:
+        src = os.path.join(run, name)
+        if os.path.exists(src):
+            shutil.copy(src, out)
+    for src in glob.glob(os.path.join(run, "metric-*.txt")):
+        shutil.copy(src, out)
+    fakes = sorted(glob.glob(os.path.join(run, "fakes*.png")))
+    if fakes:
+        shutil.copy(fakes[0], os.path.join(out, "grid_first.png"))
+        shutil.copy(fakes[-1], os.path.join(
+            out, f"grid_latest_{os.path.basename(fakes[-1])[5:11]}.png"))
+
+    # A grid of REAL samples from the exact dataset config, for the
+    # side-by-side the reference's qualitative eval relied on.
+    from gansformer_tpu.core.config import ExperimentConfig
+    from gansformer_tpu.data.dataset import make_dataset
+    from gansformer_tpu.utils.image import save_image_grid
+
+    with open(os.path.join(run, "config.json")) as f:
+        cfg = ExperimentConfig.from_json(f.read())
+    ds = make_dataset(cfg.data)
+    batch = next(ds.batches(16, seed=123))
+    save_image_grid(batch["image"], os.path.join(out, "grid_reals.png"),
+                    drange=(0, 255))
+
+    print(f"harvested into {out}: {sorted(os.listdir(out))}")
+
+
+if __name__ == "__main__":
+    main()
