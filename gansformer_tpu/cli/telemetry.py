@@ -1,0 +1,124 @@
+"""Telemetry CLI — inspect a run dir's observability artifacts.
+
+Three subcommands over the files the train loop writes
+(docs/observability.md):
+
+  trace       events.jsonl → Chrome-trace JSON (open in chrome://tracing
+              or https://ui.perfetto.dev)
+  heartbeats  staleness probe over heartbeat-p*.json; exit 1 when any
+              peer is stale/missing (babysitter-scriptable)
+  summary     per-phase totals aggregated from events.jsonl + the
+              current telemetry.prom
+
+Examples
+--------
+  python -m gansformer_tpu.cli.telemetry trace results/00003-run
+  python -m gansformer_tpu.cli.telemetry heartbeats results/00003-run \\
+      --max-age 120 --expected 4
+  python -m gansformer_tpu.cli.telemetry summary results/00003-run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def read_events(run_dir: str) -> List[dict]:
+    path = os.path.join(run_dir, "events.jsonl")
+    if not os.path.exists(path):
+        raise SystemExit(f"no events.jsonl under {run_dir} — was the run "
+                         f"started with this framework's train loop?")
+    out: List[dict] = []
+    dropped = 0
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                # a SIGKILL mid-append leaves a torn line; the crashed
+                # runs are exactly the ones worth inspecting, so skip it
+                dropped += 1
+    if dropped:
+        print(f"warning: skipped {dropped} torn line(s) in {path}",
+              file=sys.stderr)
+    return out
+
+
+def write_chrome_trace(run_dir: str, out: Optional[str] = None) -> str:
+    """events.jsonl lines ARE Chrome trace events; the conversion is just
+    the enclosing ``{"traceEvents": [...]}`` object."""
+    events = read_events(run_dir)
+    out = out or os.path.join(run_dir, "trace.json")
+    with open(out, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return out
+
+
+def summarize_events(events: List[dict]) -> List[dict]:
+    """Per-phase {name, count, total_ms, mean_ms}, heaviest first."""
+    agg: dict = {}
+    for ev in events:
+        a = agg.setdefault(ev["name"], {"count": 0, "total_ms": 0.0})
+        a["count"] += 1
+        a["total_ms"] += ev.get("dur", 0.0) / 1000.0
+    return sorted(
+        ({"name": n, "count": a["count"],
+          "total_ms": round(a["total_ms"], 3),
+          "mean_ms": round(a["total_ms"] / a["count"], 3)}
+         for n, a in agg.items()),
+        key=lambda r: -r["total_ms"])
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("trace", help="events.jsonl → Chrome trace JSON")
+    t.add_argument("run_dir")
+    t.add_argument("--out", default=None,
+                   help="output path (default <run_dir>/trace.json)")
+
+    h = sub.add_parser("heartbeats", help="multi-host staleness probe")
+    h.add_argument("run_dir")
+    h.add_argument("--max-age", type=float, default=300.0,
+                   help="seconds before a heartbeat counts as stale")
+    h.add_argument("--expected", type=int, default=None,
+                   help="expected process count (detects missing peers)")
+
+    s = sub.add_parser("summary", help="phase totals + current telemetry")
+    s.add_argument("run_dir")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "trace":
+        out = write_chrome_trace(args.run_dir, args.out)
+        print(f"wrote {out} — open in chrome://tracing or "
+              f"https://ui.perfetto.dev")
+    elif args.cmd == "heartbeats":
+        from gansformer_tpu.obs.heartbeat import check_heartbeats
+
+        expected = (list(range(args.expected))
+                    if args.expected is not None else None)
+        result = check_heartbeats(args.run_dir, max_age_s=args.max_age,
+                                  expected=expected)
+        print(json.dumps(result))
+        if not result["ok"]:
+            sys.exit(1)
+    elif args.cmd == "summary":
+        for row in summarize_events(read_events(args.run_dir)):
+            print("{name:<28s} n={count:<6d} total {total_ms:>10.1f} ms  "
+                  "mean {mean_ms:>8.2f} ms".format(**row))
+        prom = os.path.join(args.run_dir, "telemetry.prom")
+        if os.path.exists(prom):
+            print("\n-- telemetry.prom --")
+            sys.stdout.write(open(prom).read())
+
+
+if __name__ == "__main__":
+    main()

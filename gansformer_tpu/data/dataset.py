@@ -27,9 +27,12 @@ import os
 import queue
 import struct
 import threading
+import time
 from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
+
+from gansformer_tpu.obs import registry as telemetry
 
 
 class Dataset:
@@ -421,6 +424,11 @@ class PrefetchIterator:
 
     Exceptions raised by the producer surface on the consumer's next
     ``next()``; ``close()`` (also via context manager) stops the thread.
+
+    Telemetry (obs/registry): ``data/prefetch_queue_depth`` gauge (ready
+    batches waiting), ``data/starved_total`` counter (consumer arrived
+    to an empty queue — the producer is the bottleneck), ``data/wait_ms``
+    histogram (per-``next()`` block time), ``data/batches_total``.
     """
 
     _SENTINEL = object()
@@ -430,6 +438,10 @@ class PrefetchIterator:
         self._stop = threading.Event()
         self._finished = False
         self._error: Optional[BaseException] = None
+        self._g_depth = telemetry.gauge("data/prefetch_queue_depth")
+        self._c_starved = telemetry.counter("data/starved_total")
+        self._c_batches = telemetry.counter("data/batches_total")
+        self._h_wait_ms = telemetry.histogram("data/wait_ms")
 
         def _produce():
             try:
@@ -437,6 +449,7 @@ class PrefetchIterator:
                     while not self._stop.is_set():
                         try:
                             self._queue.put(item, timeout=0.1)
+                            self._g_depth.set(self._queue.qsize())
                             break
                         except queue.Full:
                             continue
@@ -461,12 +474,21 @@ class PrefetchIterator:
     def __next__(self) -> dict:
         if self._finished or self._stop.is_set():
             raise StopIteration
+        starved = self._queue.empty()
+        t0 = time.perf_counter()
         item = self._queue.get()
         if item is self._SENTINEL:
+            # end-of-stream teardown wait is not data starvation — don't
+            # let it skew the input-bound diagnosis counters
             self._finished = True
             if self._error is not None:
                 raise self._error
             raise StopIteration
+        if starved:                  # device-side starvation: input-bound
+            self._c_starved.inc()
+        self._h_wait_ms.observe((time.perf_counter() - t0) * 1000.0)
+        self._g_depth.set(self._queue.qsize())
+        self._c_batches.inc()
         return item
 
     def close(self) -> None:

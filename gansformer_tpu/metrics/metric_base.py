@@ -13,6 +13,8 @@ import jax
 import numpy as np
 
 from gansformer_tpu.data.dataset import Dataset, normalize_images
+from gansformer_tpu.obs import registry as telemetry
+from gansformer_tpu.obs.spans import get_tracer, span
 from gansformer_tpu.metrics.fid import compute_activation_stats, frechet_distance
 from gansformer_tpu.metrics.inception import FeatureExtractor, make_extractor
 from gansformer_tpu.metrics.inception_score import inception_score
@@ -237,9 +239,19 @@ class MetricGroup:
         out: Dict[str, float] = {}
         sweep_cache: Dict = {}   # fid/is/pr share one 50k-fake sweep
         for m in self.metrics:
-            out.update(m.run(sample_fn, dataset, self.extractor,
-                             self.cache_dir, pair_fn=pair_fn,
-                             sweep_cache=sweep_cache))
+            # Per-metric span (→ events.jsonl, nested under the loop's
+            # `metric` phase) + a duration gauge, so a slow metric sweep
+            # is attributable to the metric, not just "metrics".
+            with span(f"metric/{m.name}") as sp:
+                out.update(m.run(sample_fn, dataset, self.extractor,
+                                 self.cache_dir, pair_fn=pair_fn,
+                                 sweep_cache=sweep_cache))
+            telemetry.gauge(f"metric/{m.name}/duration_s").set(sp.duration_s)
+            telemetry.counter("metric/runs_total").inc()
+        # sweeps also run OUTSIDE the train loop's flush points (evaluate
+        # CLI, post-train experiment sweep): push the buffered span events
+        # to events.jsonl now or they die with the process / next reset
+        get_tracer().flush()
         out["calibrated"] = float(self.extractor.calibrated)
         return out
 

@@ -85,8 +85,22 @@ class BipartiteAttention(nn.Module):
         on a single chip from the plain generate/evaluate CLIs."""
         if not self.grid_shard:
             return t
-        from jax.sharding import PartitionSpec as P, get_abstract_mesh
-        mesh = get_abstract_mesh()
+        from jax.sharding import PartitionSpec as P
+        mesh = None
+        try:
+            from jax.sharding import get_abstract_mesh
+            mesh = get_abstract_mesh()
+        except ImportError:
+            pass
+        if mesh is None or mesh.empty:
+            # jax without set_mesh (0.4/0.5): the ambient mesh is whatever
+            # `with Mesh:` installed (MeshEnv.activate's fallback), so an
+            # empty ABSTRACT mesh must not silently disable grid sharding.
+            try:
+                from jax._src.mesh import thread_resources
+            except ImportError:   # private symbol gone: treat as no mesh
+                return t
+            mesh = thread_resources.env.physical_mesh
         if mesh.empty or MODEL_AXIS not in mesh.axis_names:
             return t
         spec = P(P.UNCONSTRAINED, MODEL_AXIS, *([None] * (t.ndim - 2)))

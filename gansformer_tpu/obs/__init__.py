@@ -1,0 +1,58 @@
+"""Telemetry subsystem — dependency-free observability primitives.
+
+Three layers, each importable without jax/tensorflow so host-side tools
+(data loaders, CLIs, tests) can instrument themselves for free:
+
+* ``spans``    — nestable ``span("phase")`` context managers with
+  thread-local stacks.  Per-phase wall time accumulates in a process
+  tracer (drained at each tick into ``timing/phase/*`` stats) and every
+  span is appended to ``events.jsonl`` in Chrome-trace event form.
+* ``registry`` — process-global counters / gauges / histograms with a
+  Prometheus-style text export (``telemetry.prom``, rewritten per tick).
+* ``heartbeat`` — per-process ``heartbeat-p<idx>.json`` liveness files
+  plus ``check_heartbeats()`` so a multi-host run can detect a dead
+  peer instead of hanging forever in a collective.
+
+The train loop wires all three (train/loop.py); the data pipeline,
+checkpointing, and metric layers record into the registry directly.
+``docs/observability.md`` describes the run-dir artifacts.
+"""
+
+from gansformer_tpu.obs.heartbeat import (  # noqa: F401
+    Heartbeat, check_heartbeats, device_memory_stats, read_heartbeats)
+from gansformer_tpu.obs.registry import (  # noqa: F401
+    Registry, counter, gauge, get_registry, histogram)
+from gansformer_tpu.obs.spans import (  # noqa: F401
+    Tracer, configure_tracer, get_tracer, span)
+
+_COMPILE_LISTENER = {"installed": False}
+
+
+def install_compile_listener() -> bool:
+    """Count XLA compiles into ``xla/compile_count`` (+ a duration
+    histogram ``xla/compile_ms``) via jax.monitoring.  Idempotent;
+    returns False (and stays silent) when jax or its monitoring events
+    are unavailable — telemetry must never be a dependency.
+    """
+    if _COMPILE_LISTENER["installed"]:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        # one event per actual XLA compile — NOT the per-call jaxpr-trace
+        # events, which fire on every cache hit too.  Instruments are
+        # resolved per event (cheap dict lookup) so a per-run
+        # Registry.reset() can't orphan them.
+        if "backend_compile" in event:
+            counter("xla/compile_count").inc()
+            histogram("xla/compile_ms").observe(duration * 1000.0)
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _COMPILE_LISTENER["installed"] = True
+    return True

@@ -1,0 +1,139 @@
+"""Per-process heartbeats — liveness files for multi-host runs.
+
+A multi-host JAX run that loses one process doesn't crash: the
+survivors block forever in the next collective.  The only cheap remedy
+is out-of-band liveness: every process rewrites
+``heartbeat-p<idx>.json`` (atomically) at each tick with its step,
+kimg, wall time, and device-memory stats; ``check_heartbeats()`` reads
+them all back and reports which peers are stale or missing, so an
+external babysitter (or ``python -m gansformer_tpu.cli.telemetry
+heartbeats <run_dir>``) can kill-and-restart the run instead of letting
+it hang.  Heartbeats assume the run dir is shared (NFS/GCS-fuse) or
+per-host probed — each file is self-describing either way.
+
+Clocks are injectable (``time_fn`` / ``now``) so staleness tests run on
+a fake clock rather than ``sleep()``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import socket
+import time
+from typing import Callable, Dict, List, Optional
+
+from gansformer_tpu.obs.registry import atomic_write_text, gauge
+
+_HB_RE = re.compile(r"heartbeat-p(\d+)\.json$")
+
+
+def device_memory_stats() -> Optional[dict]:
+    """Summed ``memory_stats()`` over local devices, or None when the
+    backend doesn't report (CPU) or jax isn't importable.  Also records
+    the ``device/mem_peak_bytes`` gauge as a side effect."""
+    try:
+        import jax
+
+        per_dev = [d.memory_stats() for d in jax.local_devices()]
+    except Exception:
+        return None
+    per_dev = [s for s in per_dev if s]
+    if not per_dev:
+        return None
+    out = {
+        "bytes_in_use": sum(s.get("bytes_in_use", 0) for s in per_dev),
+        "peak_bytes_in_use": sum(
+            s.get("peak_bytes_in_use", 0) for s in per_dev),
+        "num_devices": len(per_dev),
+    }
+    gauge("device/mem_peak_bytes").max(out["peak_bytes_in_use"])
+    return out
+
+
+def host_rss_peak_bytes() -> Optional[int]:
+    """Peak resident set of this process (linux ru_maxrss is KiB)."""
+    try:
+        import resource
+
+        kib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except Exception:
+        return None
+    peak = int(kib) * 1024
+    gauge("host/mem_peak_bytes").max(peak)
+    return peak
+
+
+class Heartbeat:
+    """Writer for one process's ``heartbeat-p<idx>.json``."""
+
+    def __init__(self, run_dir: str, process_index: int = 0,
+                 time_fn: Callable[[], float] = time.time):
+        self.run_dir = run_dir
+        self.process_index = process_index
+        self.path = os.path.join(run_dir,
+                                 f"heartbeat-p{process_index}.json")
+        self._time = time_fn
+        # every process needs the dir to exist for ITS file, even when
+        # process 0 hasn't finished creating the shared run dir yet
+        os.makedirs(run_dir, exist_ok=True)
+
+    def beat(self, step: int = 0, kimg: float = 0.0,
+             extra: Optional[dict] = None) -> dict:
+        rec = {
+            "process": self.process_index,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "time": self._time(),
+            "step": int(step),
+            "kimg": float(kimg),
+        }
+        mem = device_memory_stats()
+        if mem is not None:
+            rec["device_memory"] = mem
+        rss = host_rss_peak_bytes()
+        if rss is not None:
+            rec["host_rss_peak_bytes"] = rss
+        if extra:
+            rec.update(extra)
+        atomic_write_text(self.path, json.dumps(rec))
+        return rec
+
+
+def read_heartbeats(run_dir: str) -> Dict[int, dict]:
+    """{process_index: record} for every readable heartbeat file."""
+    out: Dict[int, dict] = {}
+    for path in glob.glob(os.path.join(run_dir, "heartbeat-p*.json")):
+        m = _HB_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                out[int(m.group(1))] = json.load(f)
+        except (OSError, ValueError):
+            continue  # mid-replace or torn file: next probe sees it
+    return out
+
+
+def check_heartbeats(run_dir: str, max_age_s: float = 300.0,
+                     expected: Optional[List[int]] = None,
+                     now: Optional[float] = None) -> dict:
+    """Staleness probe over a run dir's heartbeat files.
+
+    Returns ``{"ok", "ages", "stale", "missing"}`` where ``ages`` maps
+    process index → seconds since its last beat, ``stale`` lists
+    processes older than ``max_age_s``, and ``missing`` lists expected
+    indices with no file at all.  ``ok`` is True iff neither list is
+    non-empty.  ``expected=None`` checks only the processes that have
+    ever written (missing detection needs the roster).
+    """
+    now = time.time() if now is None else now
+    beats = read_heartbeats(run_dir)
+    ages = {idx: now - rec.get("time", 0.0) for idx, rec in beats.items()}
+    stale = sorted(idx for idx, age in ages.items() if age > max_age_s)
+    missing = (sorted(set(expected) - set(beats))
+               if expected is not None else [])
+    return {"ok": not stale and not missing, "ages": ages,
+            "stale": stale, "missing": missing}

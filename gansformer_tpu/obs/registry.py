@@ -1,0 +1,219 @@
+"""Counters / gauges / histograms — process-global metric registry.
+
+Instruments are created-or-fetched by slash-path name
+(``counter("data/starved_total")``); the same name always returns the
+same instrument, so instrumentation sites don't coordinate.  The
+registry exports two forms:
+
+* ``snapshot()`` — plain nested dict, embedded as the ``telemetry``
+  section of each ``stats.jsonl`` tick record (utils/logging.py).
+* ``export_text()`` — Prometheus text exposition (names sanitized,
+  ``data/wait_ms`` → ``data_wait_ms``), written atomically to
+  ``telemetry.prom`` at every tick so a node-local scraper or a human
+  ``cat`` sees current values mid-run.
+
+Histograms keep count/sum/min/max (no buckets — the per-tick consumers
+here want means and extremes, and bucket boundaries would be guesses).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Write-then-rename so a concurrent reader never sees a torn file;
+    the tmp file is removed if the write itself fails."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def prom_name(name: str) -> str:
+    """Slash-path instrument name → legal Prometheus metric name."""
+    n = _NAME_RE.sub("_", name)
+    if not n or not (n[0].isalpha() or n[0] in "_:"):
+        n = "_" + n
+    return n
+
+
+class Counter:
+    """Monotonic count.  ``inc()`` only — decrements are a gauge's job."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (queue depth, peak bytes)."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def max(self, v: float) -> None:
+        """Keep the high-water mark (peak-memory style gauges)."""
+        with self._lock:
+            self._value = max(self._value, float(v))
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Streaming count/sum/min/max of observations."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def _get(self, table: dict, others: tuple, name: str, cls):
+        with self._lock:
+            if name in table:
+                return table[name]
+            for other in others:
+                if name in other:
+                    raise TypeError(
+                        f"telemetry name {name!r} already registered as a "
+                        f"different instrument type")
+            inst = cls(name, threading.Lock())
+            table[name] = inst
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, (self._gauges, self._histograms),
+                         name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, (self._counters, self._histograms),
+                         name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(self._histograms, (self._counters, self._gauges),
+                         name, Histogram)
+
+    def reset(self) -> None:
+        """Drop every instrument.  The train loop calls this at run start
+        so telemetry.prom / stats.jsonl describe ONE run even when several
+        train() calls share a process (experiment arms, tests)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+    # -- exports -----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {n: g.value for n, g in self._gauges.items()},
+                "histograms": {
+                    n: {"count": h.count, "sum": h.sum, "mean": h.mean,
+                        "min": h.min, "max": h.max}
+                    for n, h in self._histograms.items()},
+            }
+
+    def export_text(self) -> str:
+        """Prometheus text exposition format (one family per instrument;
+        histograms as <name>_count/_sum/_min/_max).  Values use Python's
+        shortest round-trip float repr — ``%g``-style 6-digit formatting
+        would silently corrupt counters past ~1e6."""
+        def fmt(v) -> str:
+            return repr(float(v))
+
+        lines = []
+        with self._lock:
+            for n, c in sorted(self._counters.items()):
+                pn = prom_name(n)
+                lines += [f"# TYPE {pn} counter", f"{pn} {fmt(c.value)}"]
+            for n, g in sorted(self._gauges.items()):
+                pn = prom_name(n)
+                lines += [f"# TYPE {pn} gauge", f"{pn} {fmt(g.value)}"]
+            for n, h in sorted(self._histograms.items()):
+                pn = prom_name(n)
+                lines.append(f"# TYPE {pn} summary")
+                lines.append(f"{pn}_count {fmt(h.count)}")
+                lines.append(f"{pn}_sum {fmt(h.sum)}")
+                if h.count:
+                    lines.append(f"{pn}_min {fmt(h.min)}")
+                    lines.append(f"{pn}_max {fmt(h.max)}")
+        return "\n".join(lines) + "\n" if lines else ""
+
+    def write_prom(self, path: str) -> None:
+        """Atomic rewrite — a scraper never sees a torn file."""
+        atomic_write_text(path, self.export_text())
+
+
+_REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _REGISTRY.histogram(name)

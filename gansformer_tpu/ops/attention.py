@@ -147,16 +147,24 @@ def sharded_multihead_attention(
     (``BipartiteAttention(grid_shard=True)``); this op is the hand-written
     equivalent that the tests hold GSPMD to parity against.
     """
-    from jax import shard_map
+    import inspect
+
+    try:
+        from jax import shard_map
+    except ImportError:       # jax 0.4.x location
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     def inner(q_, k_, v_):
         return multihead_attention_kv_sharded(q_, k_, v_, num_heads, seq_axis)
 
+    # the replication-check kwarg was renamed check_rep → check_vma
+    check_kw = ("check_vma" if "check_vma"
+                in inspect.signature(shard_map).parameters else "check_rep")
     b = batch_axis
     return shard_map(
         inner, mesh=mesh,
         in_specs=(P(b, None, None), P(b, seq_axis, None), P(b, seq_axis, None)),
         out_specs=(P(b, None, None), P(b, None, None, seq_axis)),
-        check_vma=False,
+        **{check_kw: False},
     )(q, k, v)

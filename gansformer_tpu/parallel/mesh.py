@@ -92,8 +92,15 @@ class MeshEnv:
     def activate(self):
         """Context manager installing this mesh as the ambient mesh, so
         bare-``PartitionSpec`` sharding constraints (the sequence-parallel
-        grid sharding in ``models/attention.py``) resolve inside ``jit``."""
-        from jax.sharding import set_mesh
+        grid sharding in ``models/attention.py``) resolve inside ``jit``.
+
+        jax ≥ 0.6 exposes this as ``jax.sharding.set_mesh``; on older jax
+        (0.4.x, this container) ``Mesh`` itself is the ambient-mesh context
+        manager — same semantics for the bare-spec constraints used here."""
+        try:
+            from jax.sharding import set_mesh
+        except ImportError:
+            return self.mesh
         return set_mesh(self.mesh)
 
 

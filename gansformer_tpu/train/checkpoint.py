@@ -16,6 +16,8 @@ from typing import Optional
 import jax
 
 from gansformer_tpu.core.config import ExperimentConfig
+from gansformer_tpu.obs import registry as telemetry
+from gansformer_tpu.obs.spans import span
 from gansformer_tpu.train.state import TrainState
 
 
@@ -49,9 +51,14 @@ def save(ckpt_dir: str, state: TrainState, cfg: Optional[ExperimentConfig] = Non
 
     mgr = _manager(ckpt_dir, max_to_keep)
     step = int(jax.device_get(state.step))
-    mgr.save(step, args=ocp.args.StandardSave(state))
-    if block:
-        mgr.wait_until_finished()
+    # ckpt/write_ms measures what the TRAIN LOOP paid: staging cost for an
+    # async save, full serialize+write for a blocking one.
+    with span("ckpt/save") as sp:
+        mgr.save(step, args=ocp.args.StandardSave(state))
+        if block:
+            mgr.wait_until_finished()
+    telemetry.gauge("ckpt/write_ms").set(sp.duration_s * 1000.0)
+    telemetry.counter("ckpt/save_total").inc()
     if cfg is not None:
         cfg_path = os.path.join(ckpt_dir, "config.json")
         if not os.path.exists(cfg_path):
@@ -83,4 +90,7 @@ def restore(ckpt_dir: str, template: TrainState,
     step = step if step is not None else mgr.latest_step()
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
-    return mgr.restore(step, args=ocp.args.StandardRestore(template))
+    with span("ckpt/restore") as sp:
+        out = mgr.restore(step, args=ocp.args.StandardRestore(template))
+    telemetry.gauge("ckpt/restore_ms").set(sp.duration_s * 1000.0)
+    return out

@@ -22,8 +22,10 @@ from typing import Optional
 import jax
 import numpy as np
 
+from gansformer_tpu import obs
 from gansformer_tpu.core.config import ExperimentConfig
 from gansformer_tpu.data.dataset import PrefetchIterator, make_dataset
+from gansformer_tpu.obs.spans import span
 from gansformer_tpu.parallel.mesh import MeshEnv, local_batch_size, make_mesh
 from gansformer_tpu.train import checkpoint as ckpt
 from gansformer_tpu.train.state import TrainState, create_train_state, param_count
@@ -93,18 +95,47 @@ def train(cfg: ExperimentConfig, run_dir: str,
     env = env or make_mesh(cfg.mesh)
     # Ambient mesh for the whole run: sequence-parallel grid constraints
     # (ModelConfig.sequence_parallel) resolve bare PartitionSpecs against it.
+    # RunLogger as context manager: stats.jsonl/log.txt/TensorBoard files
+    # close (and the last write is flushed) even when training raises.
     with env.activate():
-        return _train(cfg, run_dir, env, resume, total_kimg, logger)
+        with (logger or RunLogger(run_dir)) as log:
+            return _train(cfg, run_dir, env, resume, total_kimg, log)
 
 
 def _train(cfg: ExperimentConfig, run_dir: str,
            env: MeshEnv,
-           resume: bool = False,
-           total_kimg: Optional[int] = None,
-           logger: Optional[RunLogger] = None) -> TrainState:
+           resume: bool,
+           total_kimg: Optional[int],
+           log: RunLogger) -> TrainState:
     t = cfg.train
-    log = logger or RunLogger(run_dir)
     total_kimg = total_kimg if total_kimg is not None else t.total_kimg
+
+    # --- telemetry (gansformer_tpu/obs) --------------------------------------
+    # Tracer: per-phase wall-time spans → events.jsonl (process 0 owns the
+    # run dir's trace file, same ownership rule as RunLogger) + per-tick
+    # timing/phase/* stats.  Reset first: a previous train() in this
+    # process (tests run several) must not leak span totals into tick 0.
+    tracer = obs.get_tracer()
+    tracer.reset()
+    # Registry likewise: telemetry.prom / the stats.jsonl telemetry section
+    # are PER-RUN artifacts, so a second train() in this process (the
+    # experiment CLI's arms, back-to-back tests) must start from zero.
+    # Safe: every instrumentation site created after this point (prefetch
+    # iterator) or resolving per call (ckpt, metrics, compile listener).
+    obs.get_registry().reset()
+    tracer.configure(
+        os.path.join(run_dir, "events.jsonl")
+        if jax.process_index() == 0 else None,
+        process_index=jax.process_index(),
+        truncate=not resume)
+    obs.install_compile_listener()     # xla/compile_count + xla/compile_ms
+    # Heartbeat: EVERY process writes its own liveness file so a stalled
+    # peer is visible from outside while the survivors sit in a collective.
+    # The first beat waits until state/restore resolves cur_nimg — beating
+    # step=0 here would overwrite a crashed run's last-progress record
+    # with zeros the moment --resume starts.
+    heartbeat = obs.Heartbeat(run_dir, jax.process_index())
+    prom_path = os.path.join(run_dir, "telemetry.prom")
     if t.debug_nans:
         from gansformer_tpu.utils.debug import enable_nan_debug
 
@@ -233,10 +264,11 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     noise_key = jax.random.PRNGKey(t.seed + 3)
 
     def snapshot_images(st: TrainState, kimg: float) -> None:
-        imgs = fns.sample(st.ema_params, st.w_avg, grid_z, noise_key,
-                          truncation_psi=0.7, label=grid_labels)
-        save_image_grid(np.asarray(jax.device_get(imgs)),
-                        os.path.join(run_dir, f"fakes{int(kimg):06d}.png"))
+        with span("snapshot"):
+            imgs = fns.sample(st.ema_params, st.w_avg, grid_z, noise_key,
+                              truncation_psi=0.7, label=grid_labels)
+            save_image_grid(np.asarray(jax.device_get(imgs)),
+                            os.path.join(run_dir, f"fakes{int(kimg):06d}.png"))
 
     metric_group = None  # built lazily once; Inception init/jit is costly
 
@@ -260,9 +292,14 @@ def _train(cfg: ExperimentConfig, run_dir: str,
 
     # --- loop ----------------------------------------------------------------
     cur_nimg = int(jax.device_get(state.step))
+    heartbeat.beat(step=cur_nimg, kimg=cur_nimg / 1000)
     it = cur_nimg // t.batch_size
     tick = 0
     tick_start_nimg = cur_nimg
+    # Setup spans (ckpt/restore on resume) ran outside any tick window:
+    # clear the phase accumulators so tick 0's timing/phase/* partitions
+    # only its own wall time (the spans stay in events.jsonl regardless).
+    tracer.drain()
     tick_start_time = time.time()
     # Tick-averaged scalars (the reference's autosummary semantics): per-key
     # running sums accumulate ON DEVICE (a handful of scalar adds per step,
@@ -286,62 +323,83 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     base_rng = jax.random.PRNGKey(t.seed + 4)
     try:
         while cur_nimg < total_kimg * 1000:
+            # Phase spans (obs/spans.py): data_wait is the time the loop
+            # BLOCKS on the prefetch queue — previously folded silently
+            # into step time; h2d is host→device transfer/assembly; step
+            # is dispatch (under async dispatch the device work itself
+            # settles inside tick_fetch's block_until_ready).
             if use_cycle and it % t.d_reg_interval == 0:
                 # One dispatch = a full lazy-reg cycle.  Per-iteration rng
                 # derivation inside matches the unfused path exactly
                 # (held to parity in tests/test_train.py).
                 k_cycle = fns.cycle_len
-                batch_list = [next(batches) for _ in range(k_cycle)]
-                imgs_k = put_stack(np.stack(
-                    [b["image"] for b in batch_list]))
-                label_k = (put_stack(np.stack(
-                    [b["label"] for b in batch_list]))
-                    if cfg.model.label_dim and "label" in batch_list[0]
-                    else None)
-                state, sums = fns.cycle(state, imgs_k, base_rng, it, label_k)
-                it += k_cycle
-                cur_nimg += t.batch_size * k_cycle
-                for k, v in sums.items():
-                    acc_sum[k] = v if k not in acc_sum else acc_sum[k] + v
-                    acc_cnt[k] = acc_cnt.get(k, 0) + fns.cycle_counts[k]
+                with span("data_wait"):
+                    batch_list = [next(batches) for _ in range(k_cycle)]
+                with span("h2d"):
+                    imgs_k = put_stack(np.stack(
+                        [b["image"] for b in batch_list]))
+                    label_k = (put_stack(np.stack(
+                        [b["label"] for b in batch_list]))
+                        if cfg.model.label_dim and "label" in batch_list[0]
+                        else None)
+                with span("step"):
+                    state, sums = fns.cycle(state, imgs_k, base_rng, it,
+                                            label_k)
+                    it += k_cycle
+                    cur_nimg += t.batch_size * k_cycle
+                    for k, v in sums.items():
+                        acc_sum[k] = v if k not in acc_sum else acc_sum[k] + v
+                        acc_cnt[k] = acc_cnt.get(k, 0) + fns.cycle_counts[k]
             else:
-                batch = next(batches)
-                imgs = put_batch(batch["image"])
-                label = (put_batch(batch["label"])
-                         if cfg.model.label_dim and "label" in batch
-                         else None)
-                step_rng = jax.random.fold_in(base_rng, it)
+                with span("data_wait"):
+                    batch = next(batches)
+                with span("h2d"):
+                    imgs = put_batch(batch["image"])
+                    label = (put_batch(batch["label"])
+                             if cfg.model.label_dim and "label" in batch
+                             else None)
+                with span("step"):
+                    step_rng = jax.random.fold_in(base_rng, it)
 
-                d_fn = (fns.d_step_r1 if (it % t.d_reg_interval == 0)
-                        else fns.d_step)
-                state, d_aux = d_fn(state, imgs,
-                                    jax.random.fold_in(step_rng, 0), label)
-                g_fn = (fns.g_step_pl if (it % t.g_reg_interval == 0)
-                        else fns.g_step)
-                state, g_aux = g_fn(state, jax.random.fold_in(step_rng, 1),
-                                    label)
+                    d_fn = (fns.d_step_r1 if (it % t.d_reg_interval == 0)
+                            else fns.d_step)
+                    state, d_aux = d_fn(state, imgs,
+                                        jax.random.fold_in(step_rng, 0),
+                                        label)
+                    g_fn = (fns.g_step_pl if (it % t.g_reg_interval == 0)
+                            else fns.g_step)
+                    state, g_aux = g_fn(state,
+                                        jax.random.fold_in(step_rng, 1),
+                                        label)
 
-                it += 1
-                cur_nimg += t.batch_size
-                for k, v in {**d_aux, **g_aux}.items():
-                    acc_sum[k] = v if k not in acc_sum else acc_sum[k] + v
-                    acc_cnt[k] = acc_cnt.get(k, 0) + 1
+                    it += 1
+                    cur_nimg += t.batch_size
+                    for k, v in {**d_aux, **g_aux}.items():
+                        acc_sum[k] = v if k not in acc_sum else acc_sum[k] + v
+                        acc_cnt[k] = acc_cnt.get(k, 0) + 1
 
             # --- tick boundary (the ONLY host sync) -------------------------
             if cur_nimg >= tick_start_nimg + t.kimg_per_tick * 1000 or \
                     cur_nimg >= total_kimg * 1000:
-                jax.block_until_ready(state.step)
-                now = time.time()
-                sec_per_tick = now - tick_start_time
-                imgs_done = cur_nimg - tick_start_nimg
-                fetched = {k: float(jax.device_get(v)) / acc_cnt[k]
-                           for k, v in acc_sum.items()}
+                with span("tick_fetch"):
+                    jax.block_until_ready(state.step)
+                    now = time.time()
+                    sec_per_tick = now - tick_start_time
+                    imgs_done = cur_nimg - tick_start_nimg
+                    fetched = {k: float(jax.device_get(v)) / acc_cnt[k]
+                               for k, v in acc_sum.items()}
                 acc_sum, acc_cnt = {}, {}
                 if t.debug_nans:
                     from gansformer_tpu.utils.debug import check_finite_stats
 
                     check_finite_stats(
                         fetched, where=f"kimg {cur_nimg / 1000:.1f}")
+                # Per-phase breakdown for THIS tick window.  Self times
+                # (child-span time subtracted) partition covered wall
+                # time, so the timing/phase/* values sum to ≈sec_per_tick
+                # — the invariant tests/test_obs.py holds the loop to.
+                phases = tracer.drain()
+                data_wait_s = phases.get("data_wait", {}).get("total_s", 0.0)
                 stats = {
                     "Progress/tick": tick,
                     "Progress/kimg": cur_nimg / 1000,
@@ -349,6 +407,10 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                     "timing/img_per_sec": imgs_done / max(sec_per_tick, 1e-9),
                     "timing/img_per_sec_per_chip":
                         imgs_done / max(sec_per_tick, 1e-9) / n_chips,
+                    "timing/data_wait_frac":
+                        data_wait_s / max(sec_per_tick, 1e-9),
+                    **{f"timing/phase/{name}": v["self_s"]
+                       for name, v in phases.items()},
                     **fetched,
                 }
                 if flops_per_it and imgs_done:
@@ -357,7 +419,10 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                     sec_per_it = sec_per_tick / (imgs_done / t.batch_size)
                     stats["timing/mfu"] = (
                         flops_per_it / sec_per_it / (peak * 1e12))
-                log.log_tick(stats)
+                log.log_tick(stats, telemetry=obs.get_registry().snapshot())
+                heartbeat.beat(step=cur_nimg, kimg=cur_nimg / 1000)
+                if jax.process_index() == 0:
+                    obs.get_registry().write_prom(prom_path)
                 tick += 1
                 tick_start_nimg = cur_nimg
                 tick_start_time = time.time()
@@ -380,11 +445,13 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                     # every process must call it (gating on process 0 would
                     # deadlock a multi-host run).  Async: the tick only pays
                     # the staging cost; the write rides Orbax's threads.
-                    ckpt.save(ckpt_dir, state, cfg, block=False)
+                    with span("checkpoint"):
+                        ckpt.save(ckpt_dir, state, cfg, block=False)
                     log.write(f"checkpoint @ {cur_nimg / 1000:.1f} kimg")
                 if t.metric_ticks > 0 and t.metrics and \
                         tick % t.metric_ticks == 0:
-                    results = run_metrics(state)
+                    with span("metric"):
+                        results = run_metrics(state)
                     for name, val in results.items():
                         log.metric(name, val, cur_nimg / 1000)
                     log.write("metrics @ {:.1f} kimg: {}".format(
@@ -394,11 +461,20 @@ def _train(cfg: ExperimentConfig, run_dir: str,
         if profiling:
             jax.profiler.stop_trace()
         batches.close()
+        # final telemetry: whatever accumulated since the last tick still
+        # reaches events.jsonl / telemetry.prom / the heartbeat, and the
+        # heartbeat records the last step an aborted run reached.
+        tracer.flush()
+        heartbeat.beat(step=cur_nimg, kimg=cur_nimg / 1000)
+        if jax.process_index() == 0:
+            obs.get_registry().write_prom(prom_path)
 
     # final snapshot + checkpoint (skip a re-save of an already-saved step)
     snapshot_images(state, cur_nimg / 1000)
     ckpt.wait(ckpt_dir)   # settle async saves before reading latest_step
     if ckpt.latest_step(ckpt_dir) != int(jax.device_get(state.step)):
-        ckpt.save(ckpt_dir, state, cfg)
+        with span("checkpoint"):
+            ckpt.save(ckpt_dir, state, cfg)
     log.write(f"done: {cur_nimg / 1000:.1f} kimg")
+    tracer.flush()
     return state

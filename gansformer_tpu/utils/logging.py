@@ -37,6 +37,7 @@ class RunLogger:
         self.run_dir = run_dir
         self.active = active
         self.tb = None
+        self._closed = False
         if active:
             os.makedirs(run_dir, exist_ok=True)
             self.jsonl = open(os.path.join(run_dir, "stats.jsonl"), "a")
@@ -46,12 +47,21 @@ class RunLogger:
             self.tb = EventWriter(os.path.join(run_dir, "tensorboard"))
         self.t0 = time.time()
 
-    def log_tick(self, stats: Dict[str, float]) -> None:
+    def log_tick(self, stats: Dict[str, float],
+                 telemetry: Optional[dict] = None) -> None:
+        """One tick record.  ``stats`` holds flat scalars (including the
+        tracer's ``timing/phase/*`` breakdown, which therefore reaches
+        TensorBoard for free); ``telemetry`` is the registry snapshot
+        (counters/gauges/histograms), embedded as a nested section of
+        the jsonl record only — TensorBoard gets scalars, the machine
+        record gets everything."""
         if not self.active:
             return
         rec = {"time": round(time.time() - self.t0, 2), **{
             k: (round(float(v), 6) if isinstance(v, (int, float)) else v)
             for k, v in stats.items()}}
+        if telemetry is not None:
+            rec["telemetry"] = telemetry
         self.jsonl.write(json.dumps(rec) + "\n")
         self.jsonl.flush()
         if self.tb is not None:
@@ -87,11 +97,22 @@ class RunLogger:
                             step=int(kimg * 1000))
 
     def close(self) -> None:
-        if self.active:
+        """Idempotent — the context-manager exit and an explicit caller
+        close may both run (train() owns the logger's lifetime even when
+        the caller constructed it)."""
+        if self.active and not self._closed:
+            self._closed = True
             self.jsonl.close()
             self.log_file.close()
             if self.tb is not None:
                 self.tb.close()
+
+    def __enter__(self) -> "RunLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # never swallow the training exception; just release the files
+        self.close()
 
 
 def list_run_dirs(results_root: str):

@@ -78,6 +78,7 @@ def test_debug_nans_flag_and_finite_check():
                            where="kimg 3.0")
 
 
+@pytest.mark.slow  # trains two experiment arms end-to-end
 def test_experiment_matrix(tmp_path):
     """Repro-study harness (SURVEY.md §2.2 "Repro-study harness"): the
     arXiv 2303.08577 matrix — baseline vs GANsformer arms under one budget —
@@ -192,6 +193,7 @@ def test_pack_run_and_load_from_archive_and_url(tmp_path, micro_run_dir):
     assert os.path.exists(os.path.join(resolved2, "config.json"))
 
 
+@pytest.mark.slow  # full metric sweep (~minutes on CPU)
 def test_evaluate_cli_end_to_end(tmp_path, micro_run_dir, capsys):
     """evaluate CLI main() on a real run dir: restore → sharded sweep →
     metric-<name>.txt + JSON line (reference §3.3 surface).  Uses the tiny
@@ -213,6 +215,7 @@ def test_evaluate_cli_end_to_end(tmp_path, micro_run_dir, capsys):
     assert any("fid32_uncal" in f for f in files)
 
 
+@pytest.mark.slow  # full metric sweep (~minutes on CPU)
 def test_evaluate_cli_psi_sweep(micro_run_dir, capsys):
     """--psi-sweep: one metric table row per truncation value, appended to
     metric-psi-sweep.txt (the lineage's FID-vs-truncation practice; real

@@ -47,6 +47,7 @@ def test_loop_checkpoint_resumes(run_dir):
     assert os.path.exists(os.path.join(ck, "config.json"))
 
 
+@pytest.mark.slow  # a full extra training run (~minutes on virtual-CPU mesh)
 def test_loop_fused_cycle_tick(tmp_path, monkeypatch):
     """train() with TrainConfig.fused_cycle: one dispatch per lazy-reg
     cycle must still produce ticks, correctly-averaged stats (device-side
@@ -87,6 +88,7 @@ def test_loop_fused_cycle_tick(tmp_path, monkeypatch):
         and last["timing/mfu"] > 0
 
 
+@pytest.mark.slow  # two back-to-back training runs
 def test_loop_fused_cycle_resume_realigns(tmp_path):
     """Resuming a fused-cycle run at an iteration index that is NOT a
     cycle boundary (1 kimg / batch 8 = 125 iters, 125 % 2 != 0) must fall

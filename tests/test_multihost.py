@@ -74,6 +74,7 @@ def _spawn_children(tmp_path):
             assert p.returncode == 0, f"child failed:\n{out}\n{err[-3000:]}"
 
 
+@pytest.mark.slow  # two spawned processes each running a full tick loop
 def test_two_process_sharded_train_step(tmp_path):
     out_dir = _spawn_children(tmp_path)
 

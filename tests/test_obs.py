@@ -1,0 +1,249 @@
+"""Tests for the telemetry subsystem (gansformer_tpu/obs): span
+nesting/accumulation on a fake clock, counter/gauge/histogram export
+round-trips, heartbeat staleness on a monkeypatched clock, the
+check_telemetry schema lint, and the loop-integration property that the
+per-tick ``timing/phase/*`` breakdown actually accounts for the tick."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from gansformer_tpu.obs.heartbeat import (
+    Heartbeat, check_heartbeats, read_heartbeats)
+from gansformer_tpu.obs.registry import Registry, prom_name
+from gansformer_tpu.obs.spans import Tracer
+
+_spec = importlib.util.spec_from_file_location(
+    "check_telemetry",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_telemetry.py"))
+ctl = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ctl)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --- spans -----------------------------------------------------------------
+
+def test_span_nesting_self_vs_total():
+    clk = FakeClock()
+    tr = Tracer(time_fn=clk)
+    with tr.span("outer"):
+        clk.advance(1.0)
+        with tr.span("inner"):
+            clk.advance(2.0)
+        clk.advance(0.5)
+    totals = tr.drain()
+    # self time excludes children; total is inclusive
+    assert totals["outer"]["self_s"] == pytest.approx(1.5)
+    assert totals["outer"]["total_s"] == pytest.approx(3.5)
+    assert totals["inner"]["self_s"] == pytest.approx(2.0)
+    # self times partition covered wall time — the invariant the loop's
+    # timing/phase/* sum rests on
+    assert sum(v["self_s"] for v in totals.values()) == pytest.approx(3.5)
+
+
+def test_span_accumulates_across_entries_and_drain_resets():
+    clk = FakeClock()
+    tr = Tracer(time_fn=clk)
+    for _ in range(3):
+        with tr.span("phase"):
+            clk.advance(1.0)
+    totals = tr.drain()
+    assert totals["phase"]["self_s"] == pytest.approx(3.0)
+    assert totals["phase"]["count"] == 3
+    assert tr.drain() == {}   # drained
+
+
+def test_span_events_jsonl_schema(tmp_path):
+    clk = FakeClock()
+    tr = Tracer(time_fn=clk)
+    events_path = str(tmp_path / "events.jsonl")
+    tr.configure(events_path, process_index=3)
+    with tr.span("a"):
+        clk.advance(0.25)
+        with tr.span("b"):
+            clk.advance(0.5)
+    tr.flush()
+    lines = [json.loads(l) for l in open(events_path)]
+    assert [e["name"] for e in lines] == ["b", "a"]   # children close first
+    assert all(e["ph"] == "X" and e["pid"] == 3 for e in lines)
+    assert lines[1]["dur"] == pytest.approx(0.75e6)   # microseconds
+    assert ctl.check_events(events_path) == []
+
+
+def test_tracer_configure_truncates_and_reset_discards(tmp_path):
+    clk = FakeClock()
+    tr = Tracer(time_fn=clk)
+    path = str(tmp_path / "events.jsonl")
+    tr.configure(path)
+    with tr.span("old"):
+        clk.advance(1.0)
+    tr.flush()
+    tr.configure(path)           # new run: truncate
+    assert open(path).read() == ""
+    with tr.span("x"):
+        clk.advance(1.0)
+    tr.reset()                   # run start discards stale totals
+    assert tr.drain() == {}
+
+
+def test_tracer_configure_resume_appends(tmp_path):
+    """truncate=False (the loop's --resume path) preserves the crash-window
+    events the aborted process flushed."""
+    clk = FakeClock()
+    tr = Tracer(time_fn=clk)
+    path = str(tmp_path / "events.jsonl")
+    tr.configure(path)
+    with tr.span("crash_window"):
+        clk.advance(1.0)
+    tr.flush()
+    tr.configure(path, truncate=False)   # resumed run appends
+    with tr.span("resumed"):
+        clk.advance(1.0)
+    tr.flush()
+    names = [json.loads(l)["name"] for l in open(path)]
+    assert names == ["crash_window", "resumed"]
+    # truncate=False with no pre-existing file still creates it
+    tr2 = Tracer(time_fn=clk)
+    fresh = str(tmp_path / "sub" / "events.jsonl")
+    tr2.configure(fresh, truncate=False)
+    with tr2.span("a"):
+        clk.advance(0.5)
+    tr2.flush()
+    assert len(open(fresh).readlines()) == 1
+
+
+# --- registry --------------------------------------------------------------
+
+def test_registry_roundtrip_and_prom_export(tmp_path):
+    reg = Registry()
+    reg.counter("data/starved_total").inc()
+    reg.counter("data/starved_total").inc(2)
+    reg.gauge("data/prefetch_queue_depth").set(5)
+    reg.gauge("device/mem_peak_bytes").max(100)
+    reg.gauge("device/mem_peak_bytes").max(50)   # high-water keeps 100
+    for v in (1.0, 3.0):
+        reg.histogram("data/wait_ms").observe(v)
+
+    snap = reg.snapshot()
+    assert snap["counters"]["data/starved_total"] == 3
+    assert snap["gauges"]["data/prefetch_queue_depth"] == 5
+    assert snap["gauges"]["device/mem_peak_bytes"] == 100
+    assert snap["histograms"]["data/wait_ms"] == {
+        "count": 2, "sum": 4.0, "mean": 2.0, "min": 1.0, "max": 3.0}
+
+    prom = str(tmp_path / "telemetry.prom")
+    reg.write_prom(prom)
+    text = open(prom).read()
+    assert "data_starved_total 3" in text
+    assert "# TYPE data_prefetch_queue_depth gauge" in text
+    assert "data_wait_ms_count 2" in text and "data_wait_ms_sum 4" in text
+    assert ctl.check_prom(prom) == []
+
+
+def test_registry_same_name_same_instrument_and_type_conflict():
+    reg = Registry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+    with pytest.raises(ValueError):
+        reg.counter("x").inc(-1)
+
+
+def test_prom_name_sanitization():
+    assert prom_name("data/wait_ms") == "data_wait_ms"
+    assert prom_name("timing/phase/step") == "timing_phase_step"
+    assert prom_name("0bad") == "_0bad"
+
+
+# --- heartbeats ------------------------------------------------------------
+
+def test_heartbeat_write_and_staleness(tmp_path):
+    clk = FakeClock()
+    d = str(tmp_path)
+    hb0 = Heartbeat(d, 0, time_fn=clk)
+    hb1 = Heartbeat(d, 1, time_fn=clk)
+    hb0.beat(step=1000, kimg=1.0)
+    clk.advance(10.0)
+    hb1.beat(step=1000, kimg=1.0)
+
+    beats = read_heartbeats(d)
+    assert set(beats) == {0, 1} and beats[0]["step"] == 1000
+
+    # both fresh at now=+5s from hb1's beat
+    res = check_heartbeats(d, max_age_s=30.0, now=clk.t + 5.0)
+    assert res["ok"] and res["stale"] == [] and res["missing"] == []
+    # p0 beat 10 s before p1: at max_age 12 only p0 is stale
+    res = check_heartbeats(d, max_age_s=12.0, now=clk.t + 5.0)
+    assert not res["ok"] and res["stale"] == [0]
+    # a dead peer that NEVER wrote is only visible with a roster
+    res = check_heartbeats(d, max_age_s=30.0, expected=[0, 1, 2],
+                           now=clk.t + 5.0)
+    assert not res["ok"] and res["missing"] == [2]
+    for p in sorted(os.listdir(d)):
+        errs = ctl.check_heartbeat(os.path.join(d, p))
+        assert errs == [], errs
+
+
+# --- loop integration ------------------------------------------------------
+
+def test_loop_telemetry_artifacts(micro_run_dir):
+    """The acceptance property: a smoke train run produces events.jsonl,
+    telemetry.prom, heartbeat-p0.json, and per-tick timing/phase/* stats
+    whose sum accounts for sec_per_tick (within 20%)."""
+    d = micro_run_dir
+    lines = [json.loads(l) for l in open(os.path.join(d, "stats.jsonl"))]
+    assert lines
+    for rec in lines:
+        phases = {k: v for k, v in rec.items()
+                  if k.startswith("timing/phase/")}
+        assert phases, f"tick {rec.get('Progress/tick')} has no phases"
+        assert "timing/phase/step" in phases
+        assert "timing/phase/data_wait" in phases
+        ratio = sum(phases.values()) / rec["timing/sec_per_tick"]
+        assert 0.8 <= ratio <= 1.2, (ratio, phases)
+        assert 0.0 <= rec["timing/data_wait_frac"] <= 1.0
+        # the registry snapshot rides along in the jsonl record
+        assert "telemetry" in rec
+        assert rec["telemetry"]["counters"]["data/batches_total"] > 0
+
+    result = ctl.check_run_dir(d)
+    assert result["ok"], result["errors"]
+    res = check_heartbeats(d, max_age_s=24 * 3600.0, expected=[0])
+    assert res["ok"], res
+
+
+def test_read_events_skips_torn_final_line(tmp_path):
+    """A SIGKILL mid-append leaves a torn last line; the trace CLI must
+    still read the crash-window events before it."""
+    from gansformer_tpu.cli.telemetry import read_events
+
+    with open(tmp_path / "events.jsonl", "w") as f:
+        f.write(json.dumps({"name": "a", "ph": "X", "ts": 0.0, "dur": 1.0,
+                            "pid": 0, "tid": 1}) + "\n")
+        f.write('{"name": "torn", "ph"')
+    assert [e["name"] for e in read_events(str(tmp_path))] == ["a"]
+
+
+def test_loop_events_convert_to_chrome_trace(micro_run_dir, tmp_path):
+    from gansformer_tpu.cli.telemetry import (
+        summarize_events, read_events, write_chrome_trace)
+
+    out = write_chrome_trace(micro_run_dir, str(tmp_path / "trace.json"))
+    trace = json.load(open(out))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"data_wait", "step", "tick_fetch", "snapshot"} <= names
+    rows = summarize_events(read_events(micro_run_dir))
+    assert rows and rows[0]["total_ms"] >= rows[-1]["total_ms"]

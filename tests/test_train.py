@@ -217,6 +217,7 @@ def test_mbstd_sharding_collectives():
     assert "all-gather" not in straddle     # stats-only comm is acceptable
 
 
+@pytest.mark.slow  # jits the full step twice (sharded + unsharded)
 def test_sequence_parallel_grid_sharding_parity():
     """ModelConfig.sequence_parallel shards every attention block's n = H*W
     grid axis over the mesh's model axis via GSPMD constraints
