@@ -54,6 +54,27 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="fused_cycle",
                    help="disable the fused cycle (overrides a loaded "
                         "config that enabled it)")
+    # Overlap layer (ISSUE 2) — tri-state: None inherits the loaded
+    # config; both default ON via the config dataclasses.  The off
+    # switches are the synchronous parity/debug fallbacks.
+    p.add_argument("--device-prefetch", action="store_const", const=True,
+                   dest="device_prefetch", default=None,
+                   help="keep a background-thread ring of batches already "
+                        "in device memory (default on; h2d leaves the hot "
+                        "loop)")
+    p.add_argument("--no-device-prefetch", action="store_const", const=False,
+                   dest="device_prefetch",
+                   help="synchronous host->device transfer on the loop "
+                        "thread (parity fallback)")
+    p.add_argument("--async-checkpoint", action="store_const", const=True,
+                   dest="async_checkpoint", default=None,
+                   help="checkpoint/snapshot writeback on a background "
+                        "writer thread (default on; the loop only pays "
+                        "dispatch cost)")
+    p.add_argument("--no-async-checkpoint", action="store_const",
+                   const=False, dest="async_checkpoint",
+                   help="synchronous checkpoint/snapshot writes on the "
+                        "loop thread (parity fallback)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans + per-tick finite checks")
     p.add_argument("--profile-dir", default=None,
@@ -113,6 +134,9 @@ def config_from_args(args) -> ExperimentConfig:
     fc = getattr(args, "fused_cycle", None)
     if fc is not None:                # tri-state: None inherits the config
         train = dataclasses.replace(train, fused_cycle=fc)
+    ac = getattr(args, "async_checkpoint", None)
+    if ac is not None:                # tri-state: None inherits the config
+        train = dataclasses.replace(train, async_checkpoint=ac)
     if args.debug_nans:
         train = dataclasses.replace(train, debug_nans=True)
     if args.profile_dir:
@@ -121,6 +145,9 @@ def config_from_args(args) -> ExperimentConfig:
                     resolution=args.resolution)
     if args.mirror_augment:
         data = dataclasses.replace(data, mirror_augment=True)
+    dp = getattr(args, "device_prefetch", None)
+    if dp is not None:                # tri-state: None inherits the config
+        data = dataclasses.replace(data, device_prefetch=dp)
     # Mesh flags default to the loaded config's mesh (so `--resume` of a
     # sequence-parallel run keeps its layout without re-passing flags);
     # validate() enforces mesh/model consistency with one clear message.

@@ -171,6 +171,13 @@ class TrainConfig:
     # ffhq256 flagship at batch 8).
     fused_cycle: bool = False
 
+    # Async writeback (ISSUE 2 overlap layer): checkpoint saves, image
+    # snapshots, and the tick-boundary stat fetch ride background
+    # device→host copies + a bounded single-slot writer thread, so the
+    # loop thread only pays dispatch cost.  Off = fully synchronous
+    # writes on the loop thread (the parity/debug fallback).
+    async_checkpoint: bool = True
+
     # cadence (ticks are the reference's unit of logging/checkpointing)
     kimg_per_tick: int = 4
     snapshot_ticks: int = 10
@@ -206,6 +213,12 @@ class DataConfig:
     source: str = "synthetic"
     shuffle_buffer: int = 4096
     prefetch: int = 2
+    # Device-resident input prefetch (ISSUE 2 overlap layer): a background
+    # thread device_puts batches onto the mesh and keeps a small ring of
+    # them already in HBM, collapsing the loop's h2d phase to a queue pop.
+    # Off = synchronous device_put on the loop thread (parity fallback).
+    device_prefetch: bool = True
+    device_prefetch_depth: int = 2   # HBM ring size, in batches
     mirror_augment: bool = False
 
 
@@ -292,6 +305,9 @@ class ExperimentConfig:
                 f"train.fused_cycle needs d_reg_interval "
                 f"({t.d_reg_interval}) to be a multiple of g_reg_interval "
                 f"({t.g_reg_interval})")
+        if self.data.device_prefetch and self.data.device_prefetch_depth < 1:
+            errs.append(f"data.device_prefetch_depth must be ≥ 1, got "
+                        f"{self.data.device_prefetch_depth}")
         if m.mbstd_group_size > 1 and t.batch_size % m.mbstd_group_size:
             # minibatch_stddev would silently shrink the group; surface the
             # mismatch instead so the trained config means what it says.

@@ -7,4 +7,5 @@ from gansformer_tpu.data.dataset import (
     PrefetchIterator,
     make_dataset,
 )
+from gansformer_tpu.data.device_prefetch import DevicePrefetcher
 from gansformer_tpu.data.tfrecord_writer import TFRecordExporter, export_images

@@ -492,13 +492,24 @@ class PrefetchIterator:
         return item
 
     def close(self) -> None:
+        """Stop and join the producer thread.  Idempotent — the loop's
+        ``finally`` and a context-manager exit may both call it.  After
+        the join a sentinel is parked in the queue so any *consumer*
+        blocked in ``__next__`` (e.g. a ``DevicePrefetcher`` transfer
+        thread pulling from this iterator) wakes with StopIteration
+        instead of hanging on a drained queue."""
         self._stop.set()
         try:  # unblock a producer stuck on a full queue
             while True:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        try:   # wake consumers blocked on the (now idle) queue
+            self._queue.put_nowait(self._SENTINEL)
+        except queue.Full:
+            pass
 
     def __enter__(self):
         return self

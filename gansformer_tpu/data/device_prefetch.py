@@ -1,0 +1,153 @@
+"""Device-resident input prefetch — batches land in HBM before the loop
+asks for them.
+
+``PrefetchIterator`` (dataset.py) overlaps host-side decode/shuffle with
+device compute, but the host→device transfer itself still ran on the
+loop thread: every iteration paid a synchronous ``device_put`` (the
+``h2d`` span) between dispatches.  ``DevicePrefetcher`` moves that
+transfer to a second background thread and keeps a small ring
+(``depth`` batches, default 2) already resident on the devices, so the
+loop's ``h2d`` phase collapses to a queue pop of arrays that are
+already where the step program wants them.
+
+Pipeline shape (three stages, two queues)::
+
+    decode thread ──host batches──▶ transfer thread ──device batches──▶ loop
+    (PrefetchIterator)              (this module: put_fn +
+                                     block_until_ready)
+
+The transfer thread calls ``put_fn`` (the loop's sharding-aware
+``device_put`` / ``make_array_from_process_local_data`` closure) and
+then **blocks until the transfer settles**, so an item in the ring is
+genuinely in HBM — the depth gauge never counts transfers still on the
+PCIe/DMA queue, and ``data/h2d_ms`` measures real transfer time.
+``jax`` dispatch is thread-safe; the put uses explicit ``NamedSharding``
+objects, so no ambient-mesh context is needed on this thread.
+
+Telemetry (obs/registry): ``data/device_queue_depth`` gauge (batches
+resident in HBM waiting for the loop), ``data/h2d_ms`` histogram
+(per-item transfer wall time on the background thread),
+``data/device_batches_total`` counter.
+
+Exceptions from the transfer thread (or the upstream iterator) surface
+on the consumer's next ``get()``; ``close()`` joins the thread.  Close
+the *upstream* iterator first — its end-of-stream sentinel is what
+unblocks a transfer thread waiting on an empty host queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from gansformer_tpu.obs import registry as telemetry
+
+
+class DevicePrefetcher:
+    """Background-thread ``device_put`` ring over a host-batch iterator.
+
+    ``iterator`` yields host-side items; ``put_fn(item)`` returns the
+    device-resident form (arrays placed on their shardings).  The ring
+    holds at most ``depth`` device items — HBM cost is
+    ``depth × batch_bytes``, which at uint8 input batches is small next
+    to model state (ffhq256 flagship: ~6 MB/batch at batch 32).
+
+    The thread/queue/sentinel/close protocol deliberately mirrors
+    ``dataset.PrefetchIterator`` (its upstream stage) — change one, check
+    the other; ``tests/test_device_prefetch.py`` pins the layered
+    teardown order.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, iterator: Iterator, put_fn: Callable,
+                 depth: int = 2):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._finished = False
+        self._error: Optional[BaseException] = None
+        self._g_depth = telemetry.gauge("data/device_queue_depth")
+        self._c_batches = telemetry.counter("data/device_batches_total")
+        self._h_h2d_ms = telemetry.histogram("data/h2d_ms")
+
+        def _produce():
+            import jax
+
+            try:
+                for item in iterator:
+                    if self._stop.is_set():
+                        return
+                    t0 = time.perf_counter()
+                    dev = put_fn(item)
+                    # Settle the transfer HERE so the ring only holds
+                    # batches that are really in device memory.
+                    jax.block_until_ready(
+                        [x for x in jax.tree_util.tree_leaves(dev)
+                         if hasattr(x, "block_until_ready")])
+                    self._h_h2d_ms.observe(
+                        (time.perf_counter() - t0) * 1000.0)
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put(dev, timeout=0.1)
+                            self._g_depth.set(self._queue.qsize())
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # noqa: BLE001 — reraised on get()
+                self._error = e
+            finally:
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(
+            target=_produce, name="device-prefetch", daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def get(self):
+        """Pop the next device-resident item (blocks if the transfer
+        thread is behind — that block is the loop's ``data_wait``)."""
+        if self._finished or self._stop.is_set():
+            raise StopIteration
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            self._finished = True
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        self._g_depth.set(self._queue.qsize())
+        self._c_batches.inc()
+        return item
+
+    __next__ = get
+
+    def close(self) -> None:
+        """Stop and join the transfer thread.  Idempotent.  If the
+        thread is blocked pulling from an upstream ``PrefetchIterator``,
+        close that upstream first (its close() wakes blocked consumers
+        with a sentinel)."""
+        self._stop.set()
+        try:    # unblock a producer stuck on a full ring
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+        self._g_depth.set(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,96 +1,294 @@
-"""Checkpoint / resume — Orbax-backed full-state snapshots.
+"""Checkpoint / resume — crash-safe snapshots with async writeback.
 
 The reference checkpoints by pickling ``Network`` objects (code + weights)
 as ``network-snapshot-<kimg>.pkl`` and does NOT save optimizer state —
 Adam moments silently reset on resume (SURVEY.md §5 "Checkpoint / resume").
 Here the whole ``TrainState`` pytree (params, both Adam states, EMA params,
-w_avg, pl_mean, step) round-trips atomically, plus the resolved config JSON
-so a checkpoint is self-describing.  ``--resume`` auto-picks the latest step.
+w_avg, pl_mean, step) round-trips bit-exactly, plus the resolved config
+JSON so a checkpoint is self-describing.  ``--resume`` auto-picks the
+latest step.
+
+Layout: ``<ckpt_dir>/<step>/state.npz`` — the pytree's leaves in
+flatten order (dtype/shape preserved by npz), one directory per step.
+Writes are crash-safe by construction: serialize into a dot-prefixed
+temp directory on the same filesystem, ``fsync`` the file, then
+``os.replace`` the directory into place — a reader (or a ``--resume``
+after SIGKILL) can never observe a torn checkpoint, and a failed write
+leaves the previous step untouched.
+
+Async writeback (ISSUE 2 tentpole — ``TrainConfig.async_checkpoint``):
+``save(..., block=False)`` costs the loop thread O(dispatch) only:
+
+1. a device-side copy of the state (``jnp.copy`` per leaf, async
+   dispatch) — required because the step functions DONATE the state
+   buffers, so the writer cannot hold references into the live pytree;
+2. ``copy_to_host_async`` on every copied leaf — starts the D2H DMA;
+3. hand the pytree to a ``SingleSlotWriter`` thread, which settles the
+   transfers (``device_get``), serializes, fsyncs, and atomically
+   renames.
+
+The writer is single-slot: a second save while one is in flight joins
+the first (bounded backpressure, never a pile of host pytrees).  Writer
+failures are sticky and re-raised at the loop's next tick boundary via
+``check_error``; ``wait`` joins in-flight writes on exit.  Telemetry:
+``ckpt/async_inflight`` gauge, ``ckpt/async_writer_heartbeat`` gauge,
+``ckpt/async_write_ms`` histogram, ``ckpt/async_total`` /
+``ckpt/async_errors_total`` counters, plus the loop-paid ``ckpt/write_ms``
+gauge and ``ckpt/save_total`` counter.
+
+Orbax compatibility: directories written by the pre-ISSUE-2 Orbax path
+(no ``state.npz``) still restore through an Orbax fallback when the
+package is importable; all NEW writes use the self-contained npz format.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+import shutil
+from typing import Callable, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from gansformer_tpu.core.config import ExperimentConfig
 from gansformer_tpu.obs import registry as telemetry
 from gansformer_tpu.obs.spans import span
 from gansformer_tpu.train.state import TrainState
+from gansformer_tpu.utils.background import SingleSlotWriter
+
+STATE_FILE = "state.npz"
+
+_WRITERS: Dict[str, SingleSlotWriter] = {}
+
+# Test seam (tests/test_checkpoint_async.py): called with the step number
+# after the temp file is fully written, BEFORE the atomic rename — a hook
+# that raises models a mid-write crash, and the crash-safety contract is
+# that the last good checkpoint must survive it.
+_WRITE_HOOK: Optional[Callable[[int], None]] = None
+
+# ONE jitted program copying every leaf (async dispatch, no donation →
+# genuinely fresh buffers).  Per-leaf jnp.copy would pay ~a dispatch (and
+# a first-call trace) per leaf — measured at >1s of loop-thread time for
+# the micro state's ~200 leaves; the fused program is a single dispatch.
+_snap_fn = None
 
 
-_MANAGERS: dict = {}
+def _device_snapshot(leaves):
+    global _snap_fn
+    if _snap_fn is None:
+        _snap_fn = jax.jit(lambda ls: [jnp.copy(l) for l in ls])
+    return _snap_fn(leaves)
 
 
-def _manager(ckpt_dir: str, max_to_keep: int = 5):
-    """One CheckpointManager per directory — construction spins up worker
-    threads and directory scans, so save/latest_step/restore share it."""
-    import orbax.checkpoint as ocp
-
+def _writer(ckpt_dir: str) -> SingleSlotWriter:
     key = os.path.abspath(ckpt_dir)
-    if key not in _MANAGERS:
-        _MANAGERS[key] = ocp.CheckpointManager(
-            key,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                                 create=True),
-        )
-    return _MANAGERS[key]
+    if key not in _WRITERS:
+        _WRITERS[key] = SingleSlotWriter("ckpt/async")
+    return _WRITERS[key]
 
 
-def save(ckpt_dir: str, state: TrainState, cfg: Optional[ExperimentConfig] = None,
+def _leaf_key(i: int) -> str:
+    return f"leaf_{i:05d}"
+
+
+def _write_state_dir(ckpt_dir: str, step: int, host_leaves: List[np.ndarray],
+                     max_to_keep: int) -> None:
+    """Serialize → temp dir → fsync → atomic rename.  Any failure cleans
+    the temp dir and re-raises; the previous checkpoint is never touched."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    tmp = os.path.join(ckpt_dir, f".tmp-{step}-{os.getpid()}")
+    final = os.path.join(ckpt_dir, str(step))
+    try:
+        os.makedirs(tmp, exist_ok=True)
+        path = os.path.join(tmp, STATE_FILE)
+        with open(path, "wb") as f:
+            np.savez(f, __step=np.int64(step),
+                     **{_leaf_key(i): l for i, l in enumerate(host_leaves)})
+            f.flush()
+            os.fsync(f.fileno())
+        if _WRITE_HOOK is not None:
+            _WRITE_HOOK(step)
+        if os.path.isdir(final):       # re-save of the same step
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        # fsync the parent so the rename itself survives a power cut
+        dfd = os.open(ckpt_dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _apply_retention(ckpt_dir, keep=max_to_keep)
+
+
+def _apply_retention(ckpt_dir: str, keep: int) -> None:
+    if keep <= 0:
+        return
+    steps = _all_steps(ckpt_dir)
+    for s in steps[:-keep]:
+        shutil.rmtree(os.path.join(ckpt_dir, str(s)), ignore_errors=True)
+
+
+def _host_fetch(leaves) -> List[np.ndarray]:
+    """Settle the (already started) D2H copies into numpy arrays."""
+    return [np.asarray(jax.device_get(l)) for l in leaves]
+
+
+def warm_async(state: TrainState) -> None:
+    """Pre-compile the device-side snapshot program — the only compile on
+    the async save path — so the FIRST in-loop save is O(dispatch) like
+    every later one (the loop calls this during setup, where the cost
+    lands outside any tick window; the persistent compile cache makes it
+    a disk hit on warm runs)."""
+    leaves, _ = jax.tree_util.tree_flatten(state)
+    jax.block_until_ready(_device_snapshot(leaves))
+
+
+def save(ckpt_dir: str, state: TrainState,
+         cfg: Optional[ExperimentConfig] = None,
          max_to_keep: int = 5, block: bool = True) -> None:
-    """``block=False`` → async save (SURVEY.md §5: Orbax async
-    checkpointing): device buffers are staged and the write happens on
-    Orbax's background threads, so the train loop's tick stall is the
-    staging cost only.  Orbax serializes with any still-pending previous
-    save internally.  Call ``wait(ckpt_dir)`` (or a blocking save) before
-    reading ``latest_step`` for dedupe/shutdown."""
-    import orbax.checkpoint as ocp
+    """Write one checkpoint step.
 
-    mgr = _manager(ckpt_dir, max_to_keep)
+    ``block=False`` → async writeback: the call costs O(dispatch) on the
+    calling thread (device-side copy + D2H start + thread handoff); the
+    serialize/fsync/rename runs on the single-slot writer.  Call
+    ``check_error`` at tick boundaries and ``wait`` before reading
+    ``latest_step`` for dedupe/shutdown.  ``block=True`` serializes and
+    writes inline (the ``--async-checkpoint off`` fallback and the final
+    save).  Multi-host: the state is replicated, so only process 0
+    writes; the call is a no-op elsewhere (no barrier required — the
+    write involves no collectives).
+    """
+    if jax.process_index() != 0:
+        return
     step = int(jax.device_get(state.step))
-    # ckpt/write_ms measures what the TRAIN LOOP paid: staging cost for an
-    # async save, full serialize+write for a blocking one.
     with span("ckpt/save") as sp:
-        mgr.save(step, args=ocp.args.StandardSave(state))
+        leaves, _ = jax.tree_util.tree_flatten(state)
         if block:
-            mgr.wait_until_finished()
+            _write_state_dir(ckpt_dir, step, _host_fetch(leaves),
+                             max_to_keep)
+        else:
+            # Device-side copy: the live state's buffers are donated to
+            # the very next step dispatch, so the writer must own
+            # independent buffers.  One fused async dispatch.
+            snap = _device_snapshot(leaves)
+            for l in snap:
+                if hasattr(l, "copy_to_host_async"):
+                    l.copy_to_host_async()
+            _writer(ckpt_dir).submit(
+                lambda: _write_state_dir(ckpt_dir, step, _host_fetch(snap),
+                                         max_to_keep),
+                label=f"step {step}")
     telemetry.gauge("ckpt/write_ms").set(sp.duration_s * 1000.0)
     telemetry.counter("ckpt/save_total").inc()
     if cfg is not None:
         cfg_path = os.path.join(ckpt_dir, "config.json")
         if not os.path.exists(cfg_path):
+            os.makedirs(ckpt_dir, exist_ok=True)
             with open(cfg_path, "w") as f:
                 f.write(cfg.to_json())
 
 
-def wait(ckpt_dir: str) -> None:
-    """Block until any in-flight async save for this directory completes."""
+def reset_errors(ckpt_dir: str) -> None:
+    """Run-start hygiene: drop any undelivered sticky error left on this
+    directory's (process-cached) writer by a previous train() run that
+    aborted between the failure and its tick-boundary poll — otherwise a
+    healthy resume would crash on the PREVIOUS run's diagnostics."""
     key = os.path.abspath(ckpt_dir)
-    if key in _MANAGERS:
-        _MANAGERS[key].wait_until_finished()
+    if key in _WRITERS:
+        _WRITERS[key].wait(reraise=False)
+        _WRITERS[key].clear_error()
+
+
+def check_error(ckpt_dir: str) -> None:
+    """Re-raise a failed async write (the loop calls this every tick)."""
+    key = os.path.abspath(ckpt_dir)
+    if key in _WRITERS:
+        _WRITERS[key].poll()
+
+
+def wait(ckpt_dir: str, reraise: bool = True) -> None:
+    """Join any in-flight async save for this directory.  ``reraise=False``
+    is for ``finally`` blocks (a writer failure must not mask the
+    exception already unwinding — it resurfaces via ``check_error`` /
+    the next ``wait``)."""
+    key = os.path.abspath(ckpt_dir)
+    if key in _WRITERS:
+        _WRITERS[key].wait(reraise=reraise)
+
+
+def _all_steps(ckpt_dir: str) -> List[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(int(d) for d in os.listdir(ckpt_dir)
+                  if d.isdigit()
+                  and os.path.isdir(os.path.join(ckpt_dir, d)))
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
-    if not os.path.isdir(ckpt_dir):
-        return None
-    mgr = _manager(ckpt_dir)
-    return mgr.latest_step()
+    steps = _all_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def _restore_npz(path: str, template: TrainState) -> TrainState:
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    with np.load(path, allow_pickle=False) as z:
+        keys = sorted(k for k in z.files if k.startswith("leaf_"))
+        if len(keys) != len(t_leaves):
+            raise ValueError(
+                f"checkpoint {path} has {len(keys)} leaves, template has "
+                f"{len(t_leaves)} — config/model mismatch?")
+        out = []
+        for k, t in zip(keys, t_leaves):
+            arr = z[k]
+            t_shape = tuple(getattr(t, "shape", ()))
+            t_dtype = np.dtype(getattr(t, "dtype", arr.dtype))
+            if arr.dtype.kind == "V" and arr.dtype.itemsize == \
+                    t_dtype.itemsize:
+                # extension dtypes (ml_dtypes bfloat16) round-trip
+                # through npz as raw void bytes — reinterpret them
+                # against the template's dtype (bit-exact)
+                arr = arr.view(t_dtype)
+            if tuple(arr.shape) != t_shape or arr.dtype != t_dtype:
+                raise ValueError(
+                    f"checkpoint {path} leaf {k}: {arr.dtype}{arr.shape} "
+                    f"does not match template {t_dtype}{t_shape}")
+            # jnp.array COPIES into an XLA-owned buffer.  Returning the
+            # raw numpy leaf invites heap corruption downstream: on the
+            # CPU backend device_put can zero-copy ALIAS a suitably
+            # aligned numpy buffer, and the train steps donate the state
+            # — XLA would then reuse/free memory owned by the Python
+            # allocator (observed as "corrupted double-linked list" on
+            # the first post-resume step).
+            out.append(jnp.array(arr))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _restore_orbax(ckpt_dir: str, step: int,
+                   template: TrainState) -> TrainState:
+    """Legacy fallback for step dirs written by the pre-npz Orbax path."""
+    import orbax.checkpoint as ocp
+
+    mgr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    return mgr.restore(step, args=ocp.args.StandardRestore(template))
 
 
 def restore(ckpt_dir: str, template: TrainState,
             step: Optional[int] = None) -> TrainState:
-    """Restore into the structure of ``template`` (shapes/dtypes/shardings
-    come from the template — works under any mesh)."""
-    import orbax.checkpoint as ocp
-
-    mgr = _manager(ckpt_dir)
-    step = step if step is not None else mgr.latest_step()
+    """Restore into the structure of ``template`` (shapes/dtypes come from
+    the template; leaves come back as default-device jax arrays — callers
+    ``device_put`` onto their mesh, which works under any layout)."""
+    step = step if step is not None else latest_step(ckpt_dir)
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    npz = os.path.join(ckpt_dir, str(step), STATE_FILE)
     with span("ckpt/restore") as sp:
-        out = mgr.restore(step, args=ocp.args.StandardRestore(template))
+        if os.path.exists(npz):
+            out = _restore_npz(npz, template)
+        else:
+            out = _restore_orbax(ckpt_dir, step, template)
     telemetry.gauge("ckpt/restore_ms").set(sp.duration_s * 1000.0)
     return out

@@ -25,7 +25,9 @@ import numpy as np
 from gansformer_tpu import obs
 from gansformer_tpu.core.config import ExperimentConfig
 from gansformer_tpu.data.dataset import PrefetchIterator, make_dataset
+from gansformer_tpu.data.device_prefetch import DevicePrefetcher
 from gansformer_tpu.obs.spans import span
+from gansformer_tpu.utils.background import SingleSlotWriter
 from gansformer_tpu.parallel.mesh import MeshEnv, local_batch_size, make_mesh
 from gansformer_tpu.train import checkpoint as ckpt
 from gansformer_tpu.train.state import TrainState, create_train_state, param_count
@@ -174,6 +176,10 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     log.write(f"G params: {param_count(state.g_params):,}  "
               f"D params: {param_count(state.d_params):,}")
     ckpt_dir = os.path.join(run_dir, "checkpoints")
+    # A previous train() in this process (retry, tests) may have left an
+    # undelivered async-writer error on this directory — it was THAT
+    # run's diagnostics, not this one's.
+    ckpt.reset_errors(ckpt_dir)
     if resume:
         last = ckpt.latest_step(ckpt_dir)
         if last is not None:
@@ -183,6 +189,10 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     # replicate state across the mesh; batches arrive sharded on 'data'
     state = jax.device_put(state, env.replicated())
     fns = make_train_steps(cfg, env, batch_size=t.batch_size)
+    if t.async_checkpoint and t.snapshot_ticks:
+        # Compile the async-save staging program NOW (setup, outside any
+        # tick window) so the first in-loop checkpoint is O(dispatch).
+        ckpt.warm_async(state)
 
     # --- data ----------------------------------------------------------------
     shard = (jax.process_index(), jax.process_count())
@@ -263,12 +273,28 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                    if cfg.model.label_dim else None)
     noise_key = jax.random.PRNGKey(t.seed + 3)
 
+    # Async writeback (TrainConfig.async_checkpoint): image grids are
+    # sampled on the loop thread (dispatch only), the device→host copy is
+    # started non-blocking, and the PNG encode + file write runs on a
+    # bounded single-slot writer thread.  The sampled array is a fresh
+    # (non-donated) output, so the writer can settle it at leisure.
+    snap_writer = SingleSlotWriter("snapshot/async") \
+        if t.async_checkpoint else None
+
     def snapshot_images(st: TrainState, kimg: float) -> None:
+        path = os.path.join(run_dir, f"fakes{int(kimg):06d}.png")
         with span("snapshot"):
             imgs = fns.sample(st.ema_params, st.w_avg, grid_z, noise_key,
                               truncation_psi=0.7, label=grid_labels)
-            save_image_grid(np.asarray(jax.device_get(imgs)),
-                            os.path.join(run_dir, f"fakes{int(kimg):06d}.png"))
+            if snap_writer is not None:
+                if hasattr(imgs, "copy_to_host_async"):
+                    imgs.copy_to_host_async()
+                snap_writer.submit(
+                    lambda: save_image_grid(
+                        np.asarray(jax.device_get(imgs)), path),
+                    label=os.path.basename(path))
+            else:
+                save_image_grid(np.asarray(jax.device_get(imgs)), path)
 
     metric_group = None  # built lazily once; Inception init/jit is costly
 
@@ -311,9 +337,44 @@ def _train(cfg: ExperimentConfig, run_dir: str,
 
     # Host-side decode/shuffle runs in a background thread so the device
     # never waits on input (cfg.data.prefetch = queue depth in batches).
-    # Constructed HERE, directly inside the try, so the producer thread can
+    # Constructed HERE, directly before the try, so the producer thread can
     # never leak if anything earlier raises.
     batches = PrefetchIterator(batch_iter, depth=cfg.data.prefetch)
+
+    # Device-resident input prefetch (DataConfig.device_prefetch): a second
+    # background thread pulls host batches, device_puts them onto their
+    # shardings, and keeps a small ring already in HBM — the loop's h2d
+    # phase collapses to a queue pop.  The plan generator mirrors the loop
+    # body's single-vs-fused-cycle branch arithmetic exactly, so the data
+    # stream order (and therefore the rng/loss trajectory) is IDENTICAL to
+    # the synchronous path — parity is held by tests/test_device_prefetch.
+    dev_batches = None
+    if cfg.data.device_prefetch:
+        def host_plan(start_it):
+            i = start_it
+            while True:
+                if use_cycle and i % t.d_reg_interval == 0:
+                    bl = [next(batches) for _ in range(fns.cycle_len)]
+                    item = {"image": np.stack([b["image"] for b in bl])}
+                    if cfg.model.label_dim and "label" in bl[0]:
+                        item["label"] = np.stack([b["label"] for b in bl])
+                    yield ("stack", item)
+                    i += fns.cycle_len
+                else:
+                    b = next(batches)
+                    item = {"image": b["image"]}
+                    if cfg.model.label_dim and "label" in b:
+                        item["label"] = b["label"]
+                    yield ("single", item)
+                    i += 1
+
+        def put_item(tagged):
+            kind, d = tagged
+            put = put_stack if kind == "stack" else put_batch
+            return kind, {k: put(v) for k, v in d.items()}
+
+        dev_batches = DevicePrefetcher(
+            host_plan(it), put_item, depth=cfg.data.device_prefetch_depth)
     # jax.profiler trace (SURVEY.md §5 tracing row): the trace runs between
     # the first and second tick boundaries, i.e. it captures the SECOND tick
     # window — the one the stats log labels ``Progress/tick: 1``.  The first
@@ -333,15 +394,32 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                 # derivation inside matches the unfused path exactly
                 # (held to parity in tests/test_train.py).
                 k_cycle = fns.cycle_len
-                with span("data_wait"):
-                    batch_list = [next(batches) for _ in range(k_cycle)]
-                with span("h2d"):
-                    imgs_k = put_stack(np.stack(
-                        [b["image"] for b in batch_list]))
-                    label_k = (put_stack(np.stack(
-                        [b["label"] for b in batch_list]))
-                        if cfg.model.label_dim and "label" in batch_list[0]
-                        else None)
+                if dev_batches is not None:
+                    # Overlapped input: the ring pop is the only wait (an
+                    # empty ring means the transfer thread is behind —
+                    # genuine data starvation, so it belongs in
+                    # data_wait/‑frac).  The loop thread does NO h2d work:
+                    # the transfer ran on the background thread (its real
+                    # cost is the data/h2d_ms histogram); the empty span
+                    # keeps timing/phase/h2d present for dashboards.
+                    with span("data_wait"):
+                        kind, dev = dev_batches.get()
+                        assert kind == "stack", kind
+                        imgs_k = dev["image"]
+                        label_k = dev.get("label")
+                    with span("h2d"):
+                        pass
+                else:
+                    with span("data_wait"):
+                        batch_list = [next(batches) for _ in range(k_cycle)]
+                    with span("h2d"):
+                        imgs_k = put_stack(np.stack(
+                            [b["image"] for b in batch_list]))
+                        label_k = (put_stack(np.stack(
+                            [b["label"] for b in batch_list]))
+                            if cfg.model.label_dim and
+                            "label" in batch_list[0]
+                            else None)
                 with span("step"):
                     state, sums = fns.cycle(state, imgs_k, base_rng, it,
                                             label_k)
@@ -351,13 +429,23 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                         acc_sum[k] = v if k not in acc_sum else acc_sum[k] + v
                         acc_cnt[k] = acc_cnt.get(k, 0) + fns.cycle_counts[k]
             else:
-                with span("data_wait"):
-                    batch = next(batches)
-                with span("h2d"):
-                    imgs = put_batch(batch["image"])
-                    label = (put_batch(batch["label"])
-                             if cfg.model.label_dim and "label" in batch
-                             else None)
+                if dev_batches is not None:
+                    # see the fused-cycle branch above for the span layout
+                    with span("data_wait"):
+                        kind, dev = dev_batches.get()
+                        assert kind == "single", kind
+                        imgs = dev["image"]
+                        label = dev.get("label")
+                    with span("h2d"):
+                        pass
+                else:
+                    with span("data_wait"):
+                        batch = next(batches)
+                    with span("h2d"):
+                        imgs = put_batch(batch["image"])
+                        label = (put_batch(batch["label"])
+                                 if cfg.model.label_dim and "label" in batch
+                                 else None)
                 with span("step"):
                     step_rng = jax.random.fold_in(base_rng, it)
 
@@ -386,6 +474,15 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                     now = time.time()
                     sec_per_tick = now - tick_start_time
                     imgs_done = cur_nimg - tick_start_nimg
+                    if t.async_checkpoint:
+                        # Start every D2H copy before settling any of
+                        # them: the per-scalar fetches below then collapse
+                        # from N serial round-trips to one settle pass
+                        # (the device values were computed during the
+                        # tick; only the transfers remain).
+                        for v in acc_sum.values():
+                            if hasattr(v, "copy_to_host_async"):
+                                v.copy_to_host_async()
                     fetched = {k: float(jax.device_get(v)) / acc_cnt[k]
                                for k, v in acc_sum.items()}
                 acc_sum, acc_cnt = {}, {}
@@ -423,6 +520,13 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                 heartbeat.beat(step=cur_nimg, kimg=cur_nimg / 1000)
                 if jax.process_index() == 0:
                     obs.get_registry().write_prom(prom_path)
+                # Async-writer failures surface HERE, one tick boundary
+                # after the write started — after the tick's stats flushed
+                # (the crash record stays readable) but before new side
+                # work piles onto a dead writer.
+                ckpt.check_error(ckpt_dir)
+                if snap_writer is not None:
+                    snap_writer.poll()
                 tick += 1
                 tick_start_nimg = cur_nimg
                 tick_start_time = time.time()
@@ -441,12 +545,15 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                 if t.image_snapshot_ticks and tick % t.image_snapshot_ticks == 0:
                     snapshot_images(state, cur_nimg / 1000)
                 if t.snapshot_ticks and tick % t.snapshot_ticks == 0:
-                    # Orbax save() runs a cross-host barrier internally —
-                    # every process must call it (gating on process 0 would
-                    # deadlock a multi-host run).  Async: the tick only pays
-                    # the staging cost; the write rides Orbax's threads.
+                    # Async (t.async_checkpoint): the loop thread pays
+                    # O(dispatch) — a device-side state copy + D2H start —
+                    # and the serialize/fsync/rename rides the single-slot
+                    # writer thread (ckpt.py).  Safe to call from every
+                    # process: only process 0 writes, and the path has no
+                    # collectives, so there is no barrier to deadlock on.
                     with span("checkpoint"):
-                        ckpt.save(ckpt_dir, state, cfg, block=False)
+                        ckpt.save(ckpt_dir, state, cfg,
+                                  block=not t.async_checkpoint)
                     log.write(f"checkpoint @ {cur_nimg / 1000:.1f} kimg")
                 if t.metric_ticks > 0 and t.metrics and \
                         tick % t.metric_ticks == 0:
@@ -460,7 +567,19 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     finally:
         if profiling:
             jax.profiler.stop_trace()
+        # Close order matters: the host-side PrefetchIterator first (its
+        # close() parks a sentinel that wakes a transfer thread blocked on
+        # an empty host queue), then the DevicePrefetcher join.
         batches.close()
+        if dev_batches is not None:
+            dev_batches.close()
+        # Join in-flight background writes WITHOUT re-raising: on the
+        # exceptional path a writer failure must not mask the training
+        # exception already unwinding (it resurfaces via wait() below on
+        # the clean path).
+        if snap_writer is not None:
+            snap_writer.wait(reraise=False)
+        ckpt.wait(ckpt_dir, reraise=False)
         # final telemetry: whatever accumulated since the last tick still
         # reaches events.jsonl / telemetry.prom / the heartbeat, and the
         # heartbeat records the last step an aborted run reached.
@@ -471,6 +590,8 @@ def _train(cfg: ExperimentConfig, run_dir: str,
 
     # final snapshot + checkpoint (skip a re-save of an already-saved step)
     snapshot_images(state, cur_nimg / 1000)
+    if snap_writer is not None:
+        snap_writer.wait()   # surface any snapshot-writer failure
     ckpt.wait(ckpt_dir)   # settle async saves before reading latest_step
     if ckpt.latest_step(ckpt_dir) != int(jax.device_get(state.step)):
         with span("checkpoint"):

@@ -1,0 +1,129 @@
+"""Single-slot background writers — the host-side half of the overlap layer.
+
+The train loop's throughput discipline (ISSUE 2 / PERF.md §1b) forbids
+host work on the loop thread between dispatches.  Checkpoint writes and
+image-grid snapshots are exactly that kind of work: serialize + encode +
+fsync can cost hundreds of ms while the device idles.  ``SingleSlotWriter``
+moves them to a background thread with deliberately *bounded* buffering:
+
+* **single slot** — at most ONE job in flight.  Submitting while busy
+  first joins the previous job, so a slow disk backpressures the loop
+  instead of queueing an unbounded pile of multi-GB host pytrees.
+* **sticky failures** — a job exception is stored and re-raised (wrapped
+  in ``BackgroundWriteError``) at the next ``poll()`` / ``submit()`` /
+  ``wait()``; the train loop polls at every tick boundary, so a writer
+  crash surfaces within one tick instead of being silently swallowed.
+* **joinable** — ``wait()`` blocks until the slot is empty; the loop's
+  ``finally`` joins with ``reraise=False`` so a writer failure never
+  masks the training exception that is already unwinding.
+
+Telemetry (obs/registry), per writer ``prefix``:
+``<prefix>_inflight`` gauge (0/1), ``<prefix>_total`` /
+``<prefix>_errors_total`` counters, ``<prefix>_write_ms`` histogram, and
+``<prefix>_writer_heartbeat`` gauge (unix time of the writer thread's
+last activity — a stuck write is visible from telemetry.prom while the
+loop is still running).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class BackgroundWriteError(RuntimeError):
+    """A background writer job failed; ``__cause__`` is the original."""
+
+
+class SingleSlotWriter:
+    """Bounded (depth-1) background executor for writeback jobs."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._error_job: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def _inst(self, kind: str, suffix: str):
+        # Instruments are resolved PER CALL, not cached at construction:
+        # writers outlive a single train() run (checkpoint.py keys them by
+        # directory), and the loop resets the registry at run start — a
+        # cached Gauge would silently update an orphaned instrument.
+        from gansformer_tpu.obs import registry as telemetry
+
+        return getattr(telemetry, kind)(f"{self.prefix}{suffix}")
+
+    # -- consumer-side API (loop thread) ------------------------------------
+
+    def submit(self, job: Callable[[], None], label: str = "") -> None:
+        """Run ``job()`` on the writer thread.  Joins any in-flight job
+        first (single slot = bounded backpressure) and raises a prior
+        failure rather than burying it under new work."""
+        self.wait()                     # join + re-raise sticky error
+        with self._lock:
+            self._inst("gauge", "_inflight").set(1)
+            self._inst("gauge", "_writer_heartbeat").set(time.time())
+            self._thread = threading.Thread(
+                target=self._run, args=(job, label),
+                name=f"{self.prefix}-writer", daemon=True)
+            self._thread.start()
+
+    def poll(self) -> None:
+        """Re-raise a failed job's exception (tick-boundary check).
+        Non-blocking; a still-running job is not an error.  The error is
+        delivered ONCE and then cleared — a ``--resume`` reusing the same
+        writer (checkpoint.py keys writers by directory) starts clean
+        instead of tripping over the crash it is recovering from."""
+        with self._lock:
+            err, job = self._error, self._error_job
+            self._error = self._error_job = None
+        if err is not None:
+            raise BackgroundWriteError(
+                f"{self.prefix} background write"
+                f"{f' ({job})' if job else ''} failed: "
+                f"{type(err).__name__}: {err}") from err
+
+    def wait(self, reraise: bool = True) -> None:
+        """Join the in-flight job (if any); optionally re-raise failures.
+        ``reraise=False`` is for ``finally`` blocks where a writer error
+        must not mask the exception already unwinding."""
+        t = self._thread
+        if t is not None:
+            t.join()
+        if reraise:
+            self.poll()
+
+    def clear_error(self) -> None:
+        """Drop an undelivered sticky error WITHOUT raising it.  For run
+        starts only: a writer cached across train() runs (checkpoint.py
+        keys them by directory) may hold a failure from a previous run
+        that aborted before its tick-boundary poll — the new run must
+        not crash on it (the error was that run's secondary diagnostics;
+        its ``_errors_total`` count remains)."""
+        with self._lock:
+            self._error = self._error_job = None
+
+    @property
+    def busy(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    # -- writer thread -------------------------------------------------------
+
+    def _run(self, job: Callable[[], None], label: str) -> None:
+        t0 = time.perf_counter()
+        try:
+            job()
+            self._inst("counter", "_total").inc()
+        except BaseException as e:  # noqa: BLE001 — re-raised via poll()
+            with self._lock:
+                self._error = e
+                self._error_job = label
+            self._inst("counter", "_errors_total").inc()
+        finally:
+            self._inst("histogram", "_write_ms").observe(
+                (time.perf_counter() - t0) * 1000.0)
+            self._inst("gauge", "_writer_heartbeat").set(time.time())
+            self._inst("gauge", "_inflight").set(0)

@@ -35,21 +35,31 @@ def rng():
     return np.random.RandomState(0)
 
 
-@pytest.fixture(scope="session")
-def micro_run_dir(tmp_path_factory):
-    """ONE short end-to-end training run shared by every test that needs a
-    real run dir (tick-loop artifacts, checkpoint resume, pack/distribute):
-    compiles dominate these tests, so train once per session."""
+def micro_overlap_cfg(total_kimg=3):
+    """The shared micro-run config: overlap layer ON (the defaults —
+    device prefetch + async writeback), 1-kimg ticks, per-tick snapshots.
+    test_device_prefetch trains the same config with the overlap flags
+    OFF as its synchronous parity reference."""
     import dataclasses
 
-    from gansformer_tpu.train.loop import train
     from tests.test_train import micro_cfg
 
     cfg = micro_cfg(attention="simplex", batch=8)
-    cfg = dataclasses.replace(
+    return dataclasses.replace(
         cfg, train=dataclasses.replace(
-            cfg.train, total_kimg=1, kimg_per_tick=1, snapshot_ticks=1,
-            image_snapshot_ticks=1))
+            cfg.train, total_kimg=total_kimg, kimg_per_tick=1,
+            snapshot_ticks=1, image_snapshot_ticks=1))
+
+
+@pytest.fixture(scope="session")
+def micro_run_dir(tmp_path_factory):
+    """ONE short end-to-end training run shared by every test that needs a
+    real run dir (tick-loop artifacts, checkpoint resume, pack/distribute,
+    the ISSUE 2 overlap acceptance tests — which need ≥3 ticks): compiles
+    dominate these tests, so train once per session."""
+    from gansformer_tpu.train.loop import train
+
+    cfg = micro_overlap_cfg()
     d = str(tmp_path_factory.mktemp("micro_run"))
     import os
 

@@ -119,3 +119,29 @@ def test_cycle_artifact_flags_faster_than_physics():
         identity=IDENTITY, peak=PEAK, cycle_flops=6.4e13,
         device_kind="TPU v5 lite")
     assert any(">= 1.0" in s for s in out["suspect"])
+
+
+def test_tick_probe_extracts_overlap_evidence():
+    """build_tick_probe (ISSUE 2): per-tick h2d/checkpoint self-times and
+    data_wait_frac from stats.jsonl records, max over ticks (the ckpt
+    phase lands on the tick after the boundary that saved)."""
+    records = [
+        {"note": "non-tick record ignored"},
+        {"timing/sec_per_tick": 50.0, "timing/data_wait_frac": 0.001,
+         "timing/img_per_sec_per_chip": 2.5,
+         "timing/phase/h2d": 0.25, "timing/phase/step": 49.0},
+        {"timing/sec_per_tick": 40.0, "timing/data_wait_frac": 0.002,
+         "timing/img_per_sec_per_chip": 3.1,
+         "timing/phase/h2d": 0.0004, "timing/phase/step": 39.0,
+         "timing/phase/checkpoint": 0.002, "timing/phase/ckpt/save": 0.008},
+    ]
+    out = bench.build_tick_probe(records)
+    assert out["ticks"] == 2
+    assert out["sec_per_tick"] == 40.0
+    assert out["data_wait_frac"] == 0.002
+    assert out["img_per_sec_per_chip"] == 3.1
+    assert out["h2d_self_ms_max"] == 250.0       # max over ticks
+    assert out["checkpoint_self_ms_max"] == 2.0
+    assert out["phase_self_ms"]["save"] == 8.0   # last tick's breakdown
+    assert out["phase_self_ms"]["h2d"] == 0.4 / 1000 * 1000
+    assert bench.build_tick_probe([{"x": 1}]) == {"error": "no tick records"}

@@ -1,0 +1,280 @@
+"""Device-resident input prefetch + overlap-layer loop integration
+(ISSUE 2): DevicePrefetcher unit behavior, the layered close protocol,
+the check_hot_loop static lint, and the acceptance properties — with
+overlap enabled the loop-thread h2d/checkpoint spans collapse, while the
+rng/loss/checkpoint trajectory stays IDENTICAL to the synchronous path."""
+
+import dataclasses
+import glob
+import importlib.util
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from gansformer_tpu.data.dataset import PrefetchIterator
+from gansformer_tpu.data.device_prefetch import DevicePrefetcher
+
+_spec = importlib.util.spec_from_file_location(
+    "check_hot_loop",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_hot_loop.py"))
+chl = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chl)
+
+
+def _put(tagged):
+    kind, d = tagged
+    return kind, {k: jax.device_put(v) for k, v in d.items()}
+
+
+# --- DevicePrefetcher units -------------------------------------------------
+
+def test_device_prefetcher_preserves_order_and_lands_on_device():
+    items = [("single", {"i": np.full((2,), i, np.int32)}) for i in range(9)]
+    dp = DevicePrefetcher(iter(items), _put, depth=2)
+    got = []
+    for kind, d in dp:
+        assert kind == "single"
+        assert isinstance(d["i"], jax.Array)       # already device-resident
+        got.append(int(np.asarray(d["i"])[0]))
+    assert got == list(range(9))
+    with pytest.raises(StopIteration):
+        dp.get()
+    dp.close()
+    dp.close()                                      # idempotent
+    assert not dp._thread.is_alive()
+
+
+def test_device_prefetcher_propagates_transfer_error():
+    def bad():
+        yield ("single", {"x": np.zeros(2, np.float32)})
+        raise RuntimeError("h2d boom")
+
+    dp = DevicePrefetcher(bad(), _put, depth=2)
+    kind, _ = dp.get()
+    assert kind == "single"
+    with pytest.raises(RuntimeError, match="h2d boom"):
+        dp.get()
+    dp.close()
+
+
+def test_device_prefetcher_telemetry_counts():
+    from gansformer_tpu.obs import registry as telemetry
+
+    reg = telemetry.get_registry()
+    before = reg.counter("data/device_batches_total").value
+    h_before = reg.histogram("data/h2d_ms").count
+    items = [("single", {"i": np.zeros(3, np.float32)}) for _ in range(5)]
+    with DevicePrefetcher(iter(items), _put, depth=2) as dp:
+        n = sum(1 for _ in dp)
+    assert n == 5
+    assert reg.counter("data/device_batches_total").value == before + 5
+    assert reg.histogram("data/h2d_ms").count >= h_before + 5
+
+
+def test_layered_close_unblocks_transfer_thread():
+    """The loop's teardown order: closing the host PrefetchIterator must
+    wake a DevicePrefetcher thread blocked on the empty host queue, so
+    the subsequent DevicePrefetcher.close() joins promptly."""
+    def slow_infinite():
+        i = 0
+        while True:
+            yield ("single", {"i": np.full((1,), i, np.int32)})
+            i += 1
+
+    host = PrefetchIterator(slow_infinite(), depth=2)
+    dp = DevicePrefetcher(iter(host), _put, depth=2)
+    dp.get()                           # pipeline is live
+    host.close()                       # parks the wake-up sentinel
+    dp.close()
+    assert not dp._thread.is_alive()
+    assert not host._thread.is_alive()
+
+
+def test_prefetch_iterator_close_is_idempotent_and_wakes_consumers():
+    src = ({"i": i} for i in iter(int, 1))      # infinite
+    it = PrefetchIterator(src, depth=2)
+    next(it)
+    done = threading.Event()
+
+    def consumer():
+        try:
+            while True:
+                next(it)
+        except StopIteration:
+            done.set()
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    it.close()
+    it.close()
+    assert done.wait(5.0), "blocked consumer was not woken by close()"
+    assert not it._thread.is_alive()
+
+
+# --- check_hot_loop static lint ---------------------------------------------
+
+def test_check_hot_loop_passes_on_real_loop():
+    result = chl.check_file(chl._DEFAULT_TARGET)
+    assert result["ok"], result["violations"]
+    assert result["checked"] >= 1
+
+
+def test_check_hot_loop_catches_violations():
+    bad = """
+def _train(x):
+    while x < 10:
+        jax.block_until_ready(x)
+        y = jax.device_get(x)
+        with span("tick_fetch"):
+            z = jax.device_get(x)      # sanctioned
+        x += 1
+"""
+    res = chl.check_source(bad)
+    assert not res["ok"]
+    assert sorted(v["call"] for v in res["violations"]) == \
+        ["block_until_ready", "device_get"]
+    ok = """
+def _train(x):
+    while x < 10:
+        with span("tick_fetch"):
+            jax.block_until_ready(x)
+            v = float(jax.device_get(x))
+        x += 1
+"""
+    assert chl.check_source(ok)["ok"]
+    # a loop.py without the expected shape must fail loudly, not pass
+    assert chl.check_source("def other(): pass")["checked"] == 0
+
+
+# --- loop integration: overlap vs sync --------------------------------------
+#
+# The OVERLAP member of the pair is the shared session micro run
+# (tests/conftest.py) — it trains with the default flags, i.e. device
+# prefetch + async writeback ON, for 3 ticks.  Only the synchronous
+# parity reference is trained here, and only for ONE tick (tier-1 time
+# budget): the comparisons use the common tick prefix — with the same
+# seed the trajectories are independent of total_kimg, which only
+# decides when training stops.
+
+def _sync_cfg(total_kimg=1):
+    from tests.conftest import micro_overlap_cfg
+
+    cfg = micro_overlap_cfg(total_kimg=total_kimg)
+    return dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(cfg.train, async_checkpoint=False),
+        data=dataclasses.replace(cfg.data, device_prefetch=False))
+
+
+@pytest.fixture(scope="module")
+def sync_run_dir(tmp_path_factory):
+    from gansformer_tpu.train.loop import train
+
+    d = str(tmp_path_factory.mktemp("sync_run"))
+    train(_sync_cfg(), d)
+    return d
+
+
+def _ticks(run_dir):
+    lines = [json.loads(l)
+             for l in open(os.path.join(run_dir, "stats.jsonl"))]
+    return [r for r in lines if "timing/sec_per_tick" in r]
+
+
+def test_overlap_collapses_h2d_span(micro_run_dir, sync_run_dir):
+    """Acceptance: with overlap enabled (≥3 ticks), per-tick loop-thread
+    h2d self-time < 10% of its sync-mode value.  The overlap side uses
+    steady-state ticks (the first pays compiles); the sync reference's
+    single tick is usable as-is — its h2d span is pure device_put work
+    (compiles land in the step span), measured in the same 200–370 ms
+    band as steady sync ticks."""
+    over = _ticks(micro_run_dir)
+    sync = _ticks(sync_run_dir)
+    assert len(over) >= 3 and len(sync) >= 1
+    s = np.mean([r["timing/phase/h2d"] for r in sync])
+    o = np.mean([r["timing/phase/h2d"] for r in over[1:]])
+    assert s > 0
+    assert o < 0.10 * s, (o, s)
+
+
+def test_overlap_checkpoint_span_is_dispatch_only(
+        micro_run_dir, sync_run_dir):
+    """Acceptance: the loop-thread checkpoint cost must not include the
+    serialize/fsync work (that rides the writer thread).  The loop-thread
+    cost is the ``checkpoint`` span plus its ``ckpt/save`` child (self
+    times are exclusive); the phase lands on the tick AFTER the boundary
+    that saved."""
+    def write_ms(run_dir):
+        # ckpt/write_ms is what the LOOP THREAD paid for its last save
+        # (full serialize+fsync in sync mode, staging dispatch in async
+        # mode); the final telemetry.prom carries it for any tick count.
+        for line in open(os.path.join(run_dir, "telemetry.prom")):
+            if line.startswith("ckpt_write_ms "):
+                return float(line.split()[1])
+        raise AssertionError(f"{run_dir}: no ckpt_write_ms in prom")
+
+    s, o = write_ms(sync_run_dir), write_ms(micro_run_dir)
+    assert s > 0
+    # At micro scale the margin is modest (the state is ~1 MB, so the
+    # sync write is only tens-to-hundreds of ms); the size-independence
+    # property — the actual O(dispatch) claim — is pinned with a 64 MB
+    # state in tests/test_checkpoint_async.py::test_async_save_loop_
+    # cost_is_dispatch_bound.
+    assert o < 0.5 * s, (o, s)
+
+
+def test_overlap_device_queue_telemetry(micro_run_dir, sync_run_dir):
+    last = _ticks(micro_run_dir)[-1]
+    gauges = last["telemetry"]["gauges"]
+    hists = last["telemetry"]["histograms"]
+    assert "data/device_queue_depth" in gauges
+    assert hists["data/h2d_ms"]["count"] > 0
+    assert "ckpt/async_writer_heartbeat" in gauges
+    # sync mode must NOT have spun up the device ring or the writers
+    sync_gauges = _ticks(sync_run_dir)[-1]["telemetry"]["gauges"]
+    assert "data/device_queue_depth" not in sync_gauges
+    assert "ckpt/async_inflight" not in sync_gauges
+
+
+def test_overlap_parity_losses_and_checkpoint(micro_run_dir, sync_run_dir):
+    """Acceptance: with overlap off vs on (same seed), the rng stream /
+    loss curves / checkpoint contents / image grids are identical at fp
+    noise — the overlap layer moves work, it must not change math."""
+    over, sync = _ticks(micro_run_dir), _ticks(sync_run_dir)
+    common = min(len(over), len(sync))
+    assert common >= 1
+    for rs, ro in zip(sync[:common], over[:common]):
+        keys = [k for k in rs if k.startswith("Loss/")]
+        assert keys
+        for k in keys:
+            assert ro[k] == pytest.approx(rs[k], abs=1e-6), (k, rs[k], ro[k])
+
+    # checkpoint contents at the last COMMON step, serialized leaves
+    def leaves(run_dir, step):
+        from gansformer_tpu.train.checkpoint import STATE_FILE
+
+        p = os.path.join(run_dir, "checkpoints", str(step), STATE_FILE)
+        with np.load(p, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    from gansformer_tpu.train.checkpoint import latest_step
+
+    step = latest_step(os.path.join(sync_run_dir, "checkpoints"))
+    s_leaves = leaves(sync_run_dir, step)
+    o_leaves = leaves(micro_run_dir, step)
+    assert set(s_leaves) == set(o_leaves)
+    for k in s_leaves:
+        assert np.array_equal(s_leaves[k], o_leaves[k]), k
+
+    # image grids rode the async writer — bytes must match the sync ones
+    pngs = sorted(glob.glob(os.path.join(sync_run_dir, "fakes*.png")))
+    assert pngs
+    for p in pngs:
+        q = os.path.join(micro_run_dir, os.path.basename(p))
+        assert os.path.exists(q)
+        assert open(p, "rb").read() == open(q, "rb").read(), p
