@@ -1,0 +1,36 @@
+"""graftlint — JAX-aware static analysis for this repo (ISSUE 3).
+
+Ordinary linters and the type checker cannot see the two classes of bug
+that silently destroy TPU throughput and reproducibility: host syncs
+inside a traced region, and misuse of explicit state the JAX model makes
+the programmer carry (donated buffers, PRNG keys, background-thread
+shared state).  This package is a small rule-based framework over one
+AST walk per file:
+
+* ``engine``       — ``Rule`` registry + visitor driver, inline
+                     suppressions, per-file context.
+* ``jit_regions``  — the shared resolver for "which functions run under
+                     ``jax.jit``/``pjit``/``shard_map``" (decorator,
+                     call wrap, or ``partial``) — used by several rules.
+* ``rules/``       — one module per rule; importing ``rules`` registers
+                     them all.
+* ``baseline``     — checked-in allowlist so pre-existing findings don't
+                     block CI while new ones do.
+* ``reporters``    — text / JSON rendering.
+* ``cli``          — the ``gansformer-lint`` console entry point.
+* ``telemetry_schema`` — the run-dir artifact lint (events.jsonl /
+                     telemetry.prom / heartbeats) migrated from
+                     ``scripts/check_telemetry.py``; not AST-based, but
+                     it reports through the same ``Finding`` type.
+
+Suppression syntax (same line as the finding)::
+
+    x = bad_thing()   # graftlint: disable=<rule-id>[,<rule-id>]
+
+See docs/static-analysis.md for the rule catalog and workflow.
+"""
+
+from gansformer_tpu.analysis.findings import Finding  # noqa: F401
+from gansformer_tpu.analysis.engine import (  # noqa: F401
+    Rule, all_rules, get_rule, lint_file, lint_paths, lint_source, register,
+)

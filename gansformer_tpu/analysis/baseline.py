@@ -1,0 +1,112 @@
+"""Checked-in baseline: pre-existing findings that don't fail the lint.
+
+The baseline file is JSON with one entry per allowed finding, keyed by
+``rule :: path :: stripped-text-of-flagged-line`` (see
+``Finding.baseline_key``) — content-addressed so pure line-number drift
+doesn't invalidate it, while editing the flagged line itself does (the
+finding then resurfaces as *new*, which is the point: touched code must
+meet the current bar).
+
+Paths inside the file are stored relative to the baseline file's
+directory with ``/`` separators, and entries are written sorted — so
+``--fix-baseline`` is deterministic byte-for-byte and diffs are small.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Dict, Iterable, List
+
+from gansformer_tpu.analysis.findings import Finding
+
+VERSION = 1
+
+
+def _rel(path: str, root: str) -> str:
+    try:
+        rel = os.path.relpath(os.path.abspath(path), root)
+    except ValueError:       # different drive (windows) — keep absolute
+        rel = os.path.abspath(path)
+    return rel.replace(os.sep, "/")
+
+
+class Baseline:
+    """Multiset of baseline keys (several identical lines may each carry
+    the same finding; each baselined occurrence needs its own entry)."""
+
+    def __init__(self, root: str = ".",
+                 keys: Iterable[str] = ()):
+        self.root = os.path.abspath(root)
+        self._keys = collections.Counter(keys)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        root = os.path.dirname(os.path.abspath(path)) or "."
+        if not os.path.exists(path):
+            return cls(root)
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(root, (e["key"] for e in data.get("entries", ())))
+
+    def _key(self, finding: Finding, line_text: str) -> str:
+        rel = _rel(finding.path, self.root)
+        return Finding(**{**finding.__dict__, "path": rel}) \
+            .baseline_key(line_text)
+
+    def apply(self, findings: List[Finding],
+              line_text_of) -> None:
+        """Mark matching findings ``baselined`` (consuming entries, so N
+        baseline entries absolve at most N identical findings).
+        ``line_text_of(finding)`` returns the flagged line's text."""
+        budget = collections.Counter(self._keys)
+        for f in findings:
+            if f.suppressed:
+                continue
+            key = self._key(f, line_text_of(f))
+            if budget[key] > 0:
+                budget[key] -= 1
+                f.baselined = True
+
+    @staticmethod
+    def write(path: str, findings: List[Finding], line_text_of) -> None:
+        """Regenerate the baseline from current (non-suppressed)
+        findings — sorted, relative paths, trailing newline; running it
+        twice on the same tree produces identical bytes."""
+        root = os.path.dirname(os.path.abspath(path)) or "."
+        entries = []
+        for f in findings:
+            if f.suppressed:
+                continue
+            rel = _rel(f.path, root)
+            key = Finding(**{**f.__dict__, "path": rel}) \
+                .baseline_key(line_text_of(f))
+            entries.append({"rule": f.rule, "path": rel, "line": f.line,
+                            "key": key})
+        entries.sort(key=lambda e: (e["path"], e["rule"], e["line"],
+                                    e["key"]))
+        payload = {"version": VERSION, "entries": entries}
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f_out:
+            json.dump(payload, f_out, indent=1, sort_keys=True)
+            f_out.write("\n")
+        os.replace(tmp, path)
+
+
+def line_text_lookup(cache: Dict[str, List[str]] = None):
+    """A ``line_text_of(finding)`` reading (and caching) source files —
+    the default used by the CLI."""
+    cache = {} if cache is None else cache
+
+    def look(f: Finding) -> str:
+        if f.path not in cache:
+            try:
+                with open(f.path, encoding="utf-8") as fh:
+                    cache[f.path] = fh.read().splitlines()
+            except OSError:
+                cache[f.path] = []
+        lines = cache[f.path]
+        return lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+
+    return look

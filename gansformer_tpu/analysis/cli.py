@@ -1,0 +1,155 @@
+"""``gansformer-lint`` — run graftlint over files/directories.
+
+Usage::
+
+    gansformer-lint gansformer_tpu scripts            # lint the tree
+    gansformer-lint --format json path/to/file.py     # machine output
+    gansformer-lint --fix-baseline gansformer_tpu scripts
+    gansformer-lint --list-rules
+    gansformer-lint --run-dir results/00003-run       # artifact schema
+
+Exit codes: 0 — no new findings; 1 — new findings (or schema errors);
+2 — usage error.  "New" excludes inline-suppressed findings and entries
+matched by the baseline file (default: ``graftlint-baseline.json`` next
+to the repo's ``gansformer_tpu`` package, i.e. the checked-in one, when
+it exists; override with ``--baseline``; ``--no-baseline`` ignores it).
+
+``--fix-baseline`` regenerates the baseline from the current tree —
+sorted entries, relative paths, atomic write — so two runs on the same
+tree are byte-identical and the diff of a baseline update is readable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from gansformer_tpu.analysis import engine, reporters
+from gansformer_tpu.analysis.baseline import Baseline, line_text_lookup
+from gansformer_tpu.analysis.findings import Finding
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "graftlint-baseline.json")
+
+
+def _select_rules(select: Optional[str], ignore: Optional[str]):
+    rules = engine.all_rules()
+    if select:
+        wanted = {r.strip() for r in select.split(",") if r.strip()}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            raise SystemExit(
+                f"gansformer-lint: unknown rule(s): {sorted(unknown)} "
+                f"(see --list-rules)")
+        rules = [r for r in rules if r.id in wanted]
+    if ignore:
+        dropped = {r.strip() for r in ignore.split(",") if r.strip()}
+        rules = [r for r in rules if r.id not in dropped]
+    return rules
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gansformer-lint",
+        description="JAX-aware static analysis (graftlint, ISSUE 3): "
+                    "tracer safety, donation, RNG reuse, thread "
+                    "discipline, telemetry naming.")
+    p.add_argument("paths", nargs="*",
+                   help="files and/or directories to lint")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {DEFAULT_BASELINE} "
+                        f"when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--fix-baseline", action="store_true",
+                   help="regenerate the baseline from current findings "
+                        "(deterministic: sorted, relative paths)")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--ignore", default=None, metavar="RULES",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--run-dir", default=None, metavar="DIR",
+                   help="also schema-lint a run dir's telemetry artifacts "
+                        "(events.jsonl/telemetry.prom/heartbeats)")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also print suppressed/baselined findings")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for cls in engine.all_rules():
+            print(f"{cls.id:<26s} {cls.description}")
+        print(f"{'telemetry-schema':<26s} run-dir artifact schema "
+              f"(--run-dir; scripts/check_telemetry.py shim)")
+        return 0
+
+    if not args.paths and not args.run_dir:
+        build_parser().print_usage(sys.stderr)
+        print("gansformer-lint: no paths given", file=sys.stderr)
+        return 2
+
+    try:
+        rules = _select_rules(args.select, args.ignore)
+    except SystemExit as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    if args.fix_baseline and (args.select or args.ignore):
+        # a scoped run sees only a subset of findings; regenerating the
+        # baseline from it would silently drop every other rule's entries
+        print("gansformer-lint: --fix-baseline cannot be combined with "
+              "--select/--ignore (it regenerates the WHOLE baseline); "
+              "run it over the full rule set and lint surface",
+              file=sys.stderr)
+        return 2
+
+    files = engine.iter_python_files(args.paths)
+    if args.paths and not files:
+        # a typo'd path must not read as a green lint over zero files
+        print(f"gansformer-lint: no python files found under "
+              f"{args.paths} — misspelled path?", file=sys.stderr)
+        return 2
+    findings: List[Finding] = []
+    for path in files:
+        findings.extend(engine.lint_file(path, rules=rules))
+
+    line_text = line_text_lookup()
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+
+    if args.fix_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        Baseline.write(target, findings, line_text)
+        kept = sum(1 for f in findings if not f.suppressed)
+        print(f"gansformer-lint: wrote {kept} baseline entr"
+              f"{'y' if kept == 1 else 'ies'} to {target}")
+        return 0
+
+    if baseline_path and not args.no_baseline:
+        Baseline.load(baseline_path).apply(findings, line_text)
+
+    if args.run_dir:
+        from gansformer_tpu.analysis.telemetry_schema import lint_run_dir
+
+        findings.extend(lint_run_dir(args.run_dir))
+
+    if args.format == "json":
+        print(reporters.render_json(findings, len(files)))
+    else:
+        print(reporters.render_text(findings, len(files),
+                                    verbose=args.verbose))
+    return 0 if all(not f.new for f in findings) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,201 @@
+"""Rule registry + visitor driver: ONE ast walk per file.
+
+A ``Rule`` subscribes to ast node types; the driver parses each file
+once, builds parent links, and dispatches every node (in source order)
+to the rules subscribed to its type.  Whole-function/whole-module rules
+simply subscribe to ``ast.FunctionDef`` / ``ast.Module`` and walk their
+own subtree — the engine guarantees each node is offered exactly once
+per rule, so a rule never double-reports.
+
+The per-file ``FileContext`` carries everything rules share: source
+lines, parent links, the lazily-built jit-region index
+(``jit_regions.py``), and ``report()`` — which applies inline
+suppressions (``# graftlint: disable=<rule>``) and de-duplicates.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Type
+
+from gansformer_tpu.analysis.findings import Finding
+
+_RULE_LIST = r"([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=" + _RULE_LIST)
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=" + _RULE_LIST)
+
+
+class Rule:
+    """Base class.  Subclasses set ``id``/``description``/``hint`` and
+    ``node_types`` (the ast classes they subscribe to), and implement
+    ``check(node, ctx)`` calling ``ctx.report(self, node, message)``."""
+
+    id: str = ""
+    description: str = ""
+    hint: str = ""
+    node_types: Sequence[type] = ()
+
+    def check(self, node: ast.AST, ctx: "FileContext") -> None:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding a rule to the global registry."""
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if _REGISTRY.get(cls.id, cls) is not cls:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> List[Type[Rule]]:
+    """Every registered rule class, importing the bundled rule set on
+    first use (rules register at import time)."""
+    import gansformer_tpu.analysis.rules  # noqa: F401  (registers)
+
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id: str) -> Type[Rule]:
+    import gansformer_tpu.analysis.rules  # noqa: F401
+
+    return _REGISTRY[rule_id]
+
+
+def _parse_suppressions(lines: Sequence[str]):
+    """(per-line {lineno: set(rule ids)}, file-level set).  'all' means
+    every rule.  Comment-shaped text inside string literals can false-
+    positive here; that costs an unnecessary suppression, never a missed
+    finding on another line."""
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    for i, text in enumerate(lines, 1):
+        m = _SUPPRESS_FILE_RE.search(text)
+        if m:
+            whole_file |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            per_line[i] = {r.strip() for r in m.group(1).split(",")
+                           if r.strip()}
+    return per_line, whole_file
+
+
+class FileContext:
+    """Everything the rules share while one file is being checked."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.findings: List[Finding] = []
+        self._suppress, self._suppress_file = _parse_suppressions(self.lines)
+        self._parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        self._jit = None
+        self._seen: Set[tuple] = set()
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    @property
+    def jit(self):
+        """Lazily-built jit-region index (shared across rules)."""
+        if self._jit is None:
+            from gansformer_tpu.analysis.jit_regions import JitIndex
+
+            self._jit = JitIndex(self.tree)
+        return self._jit
+
+    def line_text(self, line: int) -> str:
+        return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        on_line = self._suppress.get(line, ())
+        return (rule_id in on_line or "all" in on_line
+                or rule_id in self._suppress_file
+                or "all" in self._suppress_file)
+
+    def report(self, rule: Rule, node, message: str,
+               hint: Optional[str] = None) -> Optional[Finding]:
+        """File a finding at ``node`` (an ast node, or an (line, col)
+        pair for non-AST locations).  Returns None on duplicates."""
+        if isinstance(node, tuple):
+            line, col = node
+        else:
+            line, col = node.lineno, node.col_offset
+        key = (rule.id, line, col, message)
+        if key in self._seen:
+            return None
+        self._seen.add(key)
+        f = Finding(rule=rule.id, path=self.path, line=line, col=col,
+                    message=message,
+                    hint=rule.hint if hint is None else hint,
+                    suppressed=self.is_suppressed(rule.id, line))
+        self.findings.append(f)
+        return f
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Iterable[Type[Rule]]] = None) -> List[Finding]:
+    """Run ``rules`` (default: all registered) over one source string."""
+    rule_classes = list(rules) if rules is not None else all_rules()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", path=path, line=e.lineno or 0,
+                        col=e.offset or 0, message=f"syntax error: {e.msg}")]
+    ctx = FileContext(path, source, tree)
+    instances = [cls() for cls in rule_classes]
+    # subscription table: ast type -> rules wanting it
+    by_type: Dict[type, List[Rule]] = {}
+    for r in instances:
+        for t in r.node_types:
+            by_type.setdefault(t, []).append(r)
+    for node in ast.walk(tree):
+        for r in by_type.get(type(node), ()):
+            r.check(node, ctx)
+    ctx.findings.sort(key=Finding.sort_key)
+    return ctx.findings
+
+
+def lint_file(path: str,
+              rules: Optional[Iterable[Type[Rule]]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path=path, rules=rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/dirs into a sorted, de-duplicated list of .py files
+    (skipping __pycache__ and dot-directories) — deterministic order so
+    reports and baselines are stable."""
+    out: Set[str] = set()
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for name in files:
+                    if name.endswith(".py"):
+                        out.add(os.path.join(root, name))
+        elif p.endswith(".py"):
+            out.add(p)
+    return sorted(out)
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Iterable[Type[Rule]]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, rules=rules))
+    return findings

@@ -1,0 +1,49 @@
+"""``Finding`` — one rule violation at one source location.
+
+A finding is born *new*; the driver may then mark it ``suppressed`` (an
+inline ``# graftlint: disable=`` comment on its line) or ``baselined``
+(matched by the checked-in baseline file).  Only new findings fail the
+lint; the other two states stay visible in the JSON report so the debt
+is auditable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str                 # rule id, e.g. "host-sync-in-jit"
+    path: str                 # as given to the driver (usually relative)
+    line: int                 # 1-based
+    col: int                  # 0-based (ast convention)
+    message: str
+    hint: str = ""            # how to fix, one line
+    suppressed: bool = False  # inline # graftlint: disable=<rule>
+    baselined: bool = False   # matched the checked-in baseline
+
+    @property
+    def new(self) -> bool:
+        return not (self.suppressed or self.baselined)
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["new"] = self.new
+        return d
+
+    def baseline_key(self, line_text: Optional[str] = None) -> str:
+        """Content-addressed identity for baseline matching: rule + path
+        + the *stripped text* of the flagged line, so pure line-number
+        drift (edits elsewhere in the file) doesn't invalidate the
+        baseline, while any edit to the flagged line itself does."""
+        text = (line_text or "").strip()
+        return f"{self.rule}::{self.path}::{text}"

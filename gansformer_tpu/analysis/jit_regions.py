@@ -1,0 +1,216 @@
+"""Shared jit-region resolver.
+
+Answers, per module, the question several rules need: *which function
+definitions execute under a JAX trace* (``jax.jit`` / ``pjit`` /
+``shard_map``), reached via
+
+* decorator — ``@jax.jit``, ``@jit``, ``@functools.partial(jax.jit, …)``,
+  ``@shard_map(…)``;
+* call wrap — ``f2 = jax.jit(f)`` or any ``jax.jit(f, …)`` appearing as
+  an expression (e.g. field values in a dataclass constructor);
+* partial — ``jax.jit(functools.partial(f, flag=True), …)``.
+
+Membership then propagates transitively: a function *referenced by
+name* from an in-region function is in the region too — plain calls,
+and references passed to higher-order tracers (``jax.lax.scan``,
+``value_and_grad``, …) alike.  Name→def resolution is by bare name
+module-wide (an over-approximation; precision costs nothing here since
+a false in-region marking only matters if the function also contains a
+host sync, which an inline suppression can then document).
+
+Also collected while walking: **donation info** — names bound to
+``jax.jit(..., donate_argnums=…)`` results and decorated defs with
+donated parameters, consumed by the donation-after-use rule.  A
+``**kwargs`` splat is resolved one level through module/function-scope
+``name = dict(donate_argnums=…)`` assignments (the idiom train/steps.py
+uses).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+_JIT_NAMES = {"jit", "pjit", "shard_map"}
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(expr: ast.AST) -> str:
+    """'jax.jit' for Attribute/Name chains; '' when not a plain chain."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_jit_wrapper(expr: ast.AST) -> bool:
+    """Does this expression name jit/pjit/shard_map?"""
+    name = dotted_name(expr)
+    return bool(name) and name.split(".")[-1] in _JIT_NAMES
+
+
+def _is_partial(expr: ast.AST) -> bool:
+    name = dotted_name(expr)
+    return bool(name) and name.split(".")[-1] == "partial"
+
+
+def _donate_positions(v: ast.AST) -> Tuple[int, ...]:
+    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+        return (v.value,)
+    if isinstance(v, (ast.Tuple, ast.List)):
+        out = []
+        for e in v.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+class JitIndex:
+    """Per-module jit-region + donation index (built once, shared)."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self._defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNC_DEFS):
+                self._defs_by_name.setdefault(node.name, []).append(node)
+        # name -> donated call-site positions for calls to that name
+        self.donating: Dict[str, Tuple[int, ...]] = {}
+        self._dict_kwargs: Dict[str, Tuple[int, ...]] = {}
+        self._collect_dict_kwargs()
+        seeds = self._collect_seeds()
+        self._region_ids: Set[int] = set()
+        self._propagate(seeds)
+
+    # -- queries -------------------------------------------------------------
+
+    def is_jit(self, func_def: ast.AST) -> bool:
+        """Is this FunctionDef (transitively) inside a jit region?"""
+        return id(func_def) in self._region_ids
+
+    @property
+    def jit_functions(self) -> Set[int]:
+        return self._region_ids
+
+    # -- seed collection -----------------------------------------------------
+
+    def _collect_dict_kwargs(self) -> None:
+        """``donate_state = dict(donate_argnums=(0,))`` assignments, so a
+        later ``jax.jit(f, **donate_state)`` resolves its donation."""
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call) and dotted_name(v.func) == "dict":
+                for kw in v.keywords:
+                    if kw.arg == "donate_argnums":
+                        self._dict_kwargs[node.targets[0].id] = \
+                            _donate_positions(kw.value)
+            elif isinstance(v, ast.Dict):
+                for k, val in zip(v.keys, v.values):
+                    if isinstance(k, ast.Constant) and \
+                            k.value == "donate_argnums":
+                        self._dict_kwargs[node.targets[0].id] = \
+                            _donate_positions(val)
+
+    def _jit_call_donations(self, call: ast.Call) -> Tuple[int, ...]:
+        pos: Tuple[int, ...] = ()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                pos += _donate_positions(kw.value)
+            elif kw.arg is None and isinstance(kw.value, ast.Name):
+                pos += self._dict_kwargs.get(kw.value.id, ())
+        return pos
+
+    def _wrapped_def(self, expr: ast.AST) -> Optional[str]:
+        """The bare name of the function a jit(...) first argument refers
+        to — directly, or through one ``partial(f, ...)`` layer."""
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Call) and _is_partial(expr.func) and \
+                expr.args and isinstance(expr.args[0], ast.Name):
+            return expr.args[0].id
+        return None
+
+    def _collect_seeds(self) -> List[ast.AST]:
+        seeds: List[ast.AST] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FUNC_DEFS):
+                for dec in node.decorator_list:
+                    if self._decorator_is_jit(dec):
+                        seeds.append(node)
+                        if isinstance(dec, ast.Call):
+                            pos = self._jit_call_donations(dec)
+                            if pos:
+                                self.donating[node.name] = pos
+                        break
+            elif isinstance(node, ast.Call) and is_jit_wrapper(node.func):
+                if node.args:
+                    name = self._wrapped_def(node.args[0])
+                    if name:
+                        seeds.extend(self._defs_by_name.get(name, ()))
+                        pos = self._jit_call_donations(node)
+                        if pos:
+                            # the jit result donates; record under the
+                            # name(s) it is assigned to
+                            for tgt in self._assign_targets_of(node):
+                                self.donating[tgt] = pos
+        return seeds
+
+    def _decorator_is_jit(self, dec: ast.AST) -> bool:
+        if is_jit_wrapper(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if is_jit_wrapper(dec.func):
+                return True
+            # @functools.partial(jax.jit, static_argnames=...)
+            if _is_partial(dec.func) and dec.args and \
+                    is_jit_wrapper(dec.args[0]):
+                return True
+        return False
+
+    def _assign_targets_of(self, call: ast.Call) -> List[str]:
+        """Names an ``X = jax.jit(...)`` call is directly assigned to.
+        Uses a parent scan over Assign nodes (cheap; runs per jit call)."""
+        out = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and node.value is call:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.append(t.id)
+        return out
+
+    # -- propagation ---------------------------------------------------------
+
+    def _references(self, func_def: ast.AST) -> Set[str]:
+        """Bare names referenced in the def's own body — nested function
+        *bodies* excluded (they propagate on their own turn when marked)."""
+        names: Set[str] = set()
+        stack = list(ast.iter_child_nodes(func_def))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNC_DEFS):
+                continue
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                names.add(node.id)
+            stack.extend(ast.iter_child_nodes(node))
+        return names
+
+    def _propagate(self, seeds: List[ast.AST]) -> None:
+        work = list(seeds)
+        while work:
+            fn = work.pop()
+            if id(fn) in self._region_ids:
+                continue
+            self._region_ids.add(id(fn))
+            for name in self._references(fn):
+                for target in self._defs_by_name.get(name, ()):
+                    if id(target) not in self._region_ids:
+                        work.append(target)

@@ -1,0 +1,55 @@
+"""Finding renderers: human text and machine JSON.
+
+Both render the same partition — *new* findings fail the lint;
+*suppressed* (inline comment) and *baselined* (checked-in debt) stay
+visible so they can be audited, but don't gate.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from gansformer_tpu.analysis.findings import Finding
+
+
+def counts(findings: List[Finding]) -> dict:
+    return {
+        "total": len(findings),
+        "new": sum(f.new for f in findings),
+        "suppressed": sum(f.suppressed for f in findings),
+        "baselined": sum(f.baselined for f in findings),
+    }
+
+
+def render_text(findings: List[Finding], files_checked: int,
+                verbose: bool = False) -> str:
+    """One line per reportable finding + summary.  Suppressed/baselined
+    findings print only with ``verbose`` (tagged, for auditing)."""
+    lines = []
+    for f in sorted(findings, key=Finding.sort_key):
+        if not f.new and not verbose:
+            continue
+        tag = "" if f.new else \
+            (" [suppressed]" if f.suppressed else " [baselined]")
+        hint = f"  (fix: {f.hint})" if f.hint and f.new else ""
+        lines.append(f"{f.location}: {f.rule}: {f.message}{hint}{tag}")
+    c = counts(findings)
+    lines.append(
+        f"graftlint: {files_checked} file(s), {c['total']} finding(s) — "
+        f"{c['new']} new, {c['suppressed']} suppressed, "
+        f"{c['baselined']} baselined")
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding], files_checked: int) -> str:
+    c = counts(findings)
+    payload = {
+        "version": 1,
+        "ok": c["new"] == 0,
+        "files_checked": files_checked,
+        "counts": c,
+        "findings": [f.to_dict()
+                     for f in sorted(findings, key=Finding.sort_key)],
+    }
+    return json.dumps(payload, indent=1, sort_keys=True)

@@ -1,0 +1,21 @@
+"""Bundled graftlint rules — importing this package registers them all.
+
+One module per rule (see docs/static-analysis.md for the catalog):
+
+* ``host_sync``       — host-sync-in-jit
+* ``donation``        — donation-after-use
+* ``rng_reuse``       — rng-key-reuse
+* ``hot_loop``        — hot-loop-sync (migrated from
+                        scripts/check_hot_loop.py, which is now a shim)
+* ``thread_state``    — thread-shared-state
+* ``telemetry_names`` — telemetry-name-convention
+"""
+
+from gansformer_tpu.analysis.rules import (  # noqa: F401
+    donation,
+    host_sync,
+    hot_loop,
+    rng_reuse,
+    telemetry_names,
+    thread_state,
+)

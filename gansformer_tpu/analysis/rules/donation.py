@@ -1,0 +1,127 @@
+"""donation-after-use: reading a buffer after donating it to a jitted call.
+
+``jax.jit(..., donate_argnums=…)`` hands the argument's device buffer
+to XLA for in-place reuse; the Python name still points at the now-
+invalid array.  Reading it after the call raises
+``RuntimeError: Array has been deleted`` on real hardware — but NOT on
+CPU test runs (donation is a no-op there), so this is exactly the bug
+class that ships to the TPU undetected.
+
+Scope: module-local, flow-insensitive across branches.  The shared jit
+index records names bound to donating ``jax.jit`` results (including
+``**dict(donate_argnums=…)`` splats and decorated defs); within each
+function (and the module body), a linear statement scan marks variables
+passed at donated positions and flags any later read before rebinding.
+The blessed pattern — ``state, aux = step(state, …)`` — rebinds on the
+same statement and never flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _flatten(stmts):
+    """Statements in source order, recursing into control flow but not
+    into nested function/class scopes (separate dispatch)."""
+    for st in stmts:
+        if isinstance(st, _FUNC_DEFS + (ast.ClassDef,)):
+            continue
+        yield st
+        for field in ("body", "orelse", "finalbody"):
+            yield from _flatten(getattr(st, field, ()) or ())
+        for h in getattr(st, "handlers", ()) or ():
+            yield from _flatten(h.body)
+
+
+@register
+class DonationAfterUse(Rule):
+    id = "donation-after-use"
+    description = ("argument donated to a jitted call (donate_argnums) "
+                   "read again after the call site")
+    hint = ("rebind the result over the donated name "
+            "(state, aux = step(state, …)) or drop donate_argnums for "
+            "buffers you still need")
+    node_types = _FUNC_DEFS + (ast.Module,)
+
+    def check(self, node: ast.AST, ctx: FileContext) -> None:
+        donating = ctx.jit.donating
+        if not donating:
+            return
+        body = node.body
+        # donated name -> (line of the donating call)
+        pending: Dict[str, int] = {}
+        for st in _flatten(body):
+            # order matters: reads happen before this statement's own
+            # donation is recorded, and the donation before the target
+            # rebinds — so ``state, aux = step(state)`` donates-then-
+            # rebinds on one line and never flags.
+            self._reads(st, pending, ctx)
+            self._donations(st, pending, donating)
+            self._rebinds(st, pending)
+
+    # -- phase 1: reads of already-donated names ----------------------------
+
+    def _reads(self, st: ast.stmt, pending: Dict[str, int],
+               ctx: FileContext) -> None:
+        if not pending:
+            return
+        for n in self._own_exprs(st):
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load) and sub.id in pending:
+                    ctx.report(
+                        self, sub,
+                        f"{sub.id!r} was donated to a jitted call on line "
+                        f"{pending[sub.id]} and is read here — its buffer "
+                        f"may already be reused (fails on TPU, silently "
+                        f"passes on CPU)")
+
+    @staticmethod
+    def _own_exprs(st: ast.stmt):
+        """The statement's direct expressions, not nested block bodies
+        (those arrive later in the flattened order)."""
+        for field, value in ast.iter_fields(st):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.expr):
+                yield value
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        yield v
+
+    # -- phase 2: rebinding clears the donated mark -------------------------
+
+    def _rebinds(self, st: ast.stmt, pending: Dict[str, int]) -> None:
+        targets = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+            targets = [st.target]
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            targets = [st.target]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    pending.pop(n.id, None)
+
+    # -- phase 3: new donations from this statement -------------------------
+
+    def _donations(self, st: ast.stmt, pending: Dict[str, int],
+                   donating: Dict[str, tuple]) -> None:
+        for n in self._own_exprs(st):
+            for sub in ast.walk(n):
+                if not (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id in donating):
+                    continue
+                for pos in donating[sub.func.id]:
+                    if pos < len(sub.args) and \
+                            isinstance(sub.args[pos], ast.Name):
+                        pending[sub.args[pos].id] = sub.lineno

@@ -1,0 +1,154 @@
+"""host-sync-in-jit: host synchronization attempted inside a traced region.
+
+Inside a function that executes under ``jax.jit``/``pjit``/``shard_map``
+(per the shared jit-region resolver), flag:
+
+* ``jax.device_get`` / ``block_until_ready`` — always an error under a
+  trace (and a per-dispatch stall even where they "work");
+* ``print(...)`` — runs at trace time only, silently NOT per step; the
+  author almost always wanted ``jax.debug.print``;
+* ``.item()`` / ``float()`` / ``int()`` / ``np.asarray`` / ``np.array``
+  applied to a **tracer-tainted** expression — these concretize, raising
+  ``TracerConversionError`` at best and hiding a sync at worst.
+
+Taint = the function's parameters plus anything transitively assigned
+from them (fixpoint over the function's assignments; order-insensitive,
+so it over-approximates — which for a linter is the safe direction).
+``float(cfg.lr)``-style trace-time constants are NOT tainted and pass;
+``int(x.shape[0])``-style static-shape reads are exempted explicitly.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+from gansformer_tpu.analysis.jit_regions import dotted_name
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_ALWAYS_BANNED = {"device_get", "block_until_ready"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype"}   # trace-time Python values
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    params = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+    if a.vararg:
+        params.append(a.vararg)
+    if a.kwarg:
+        params.append(a.kwarg)
+    return {p.arg for p in params if p.arg not in ("self", "cls")}
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    out = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def _tainted_names(fn: ast.AST) -> Set[str]:
+    """Params + transitive assignments from them (fixpoint)."""
+    taint = _param_names(fn)
+    assigns = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                assigns.append((t, node.value))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and node.value:
+            assigns.append((node.target, node.value))
+        elif isinstance(node, ast.NamedExpr):
+            assigns.append((node.target, node.value))
+        elif isinstance(node, ast.For):
+            assigns.append((node.target, node.iter))
+    changed = True
+    while changed:
+        changed = False
+        for target, value in assigns:
+            if _names_in(value) & taint:
+                new = _target_names(target) - taint
+                if new:
+                    taint |= new
+                    changed = True
+    return taint
+
+
+def _is_tainted(expr: ast.AST, taint: Set[str]) -> bool:
+    return bool(_names_in(expr) & taint)
+
+
+def _reads_static_attr(expr: ast.AST) -> bool:
+    """``x.shape[0]``-style: static under a trace, a legal int() target."""
+    return any(isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS
+               for n in ast.walk(expr))
+
+
+@register
+class HostSyncInJit(Rule):
+    id = "host-sync-in-jit"
+    description = ("host synchronization (.item()/float()/int()/"
+                   "np.asarray/device_get/block_until_ready/print) inside "
+                   "a jit/pjit/shard_map region")
+    hint = ("move the sync outside the jitted function, or use "
+            "jax.debug.print / jnp equivalents inside the trace")
+    node_types = _FUNC_DEFS
+
+    def check(self, node: ast.AST, ctx: FileContext) -> None:
+        if not ctx.jit.is_jit(node):
+            return
+        taint = _tainted_names(node)
+        # walk this def's body only — nested defs get their own dispatch
+        # (and their own in-region decision)
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _FUNC_DEFS):
+                continue
+            if isinstance(n, ast.Call):
+                self._check_call(n, taint, ctx)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _check_call(self, call: ast.Call, taint: Set[str],
+                    ctx: FileContext) -> None:
+        f = call.func
+        name = dotted_name(f)
+        last = name.split(".")[-1] if name else \
+            (f.attr if isinstance(f, ast.Attribute) else "")
+        if last in _ALWAYS_BANNED:
+            ctx.report(self, call,
+                       f"{last}() inside a jit region — forces a host "
+                       f"sync / fails on tracers")
+            return
+        if name == "print":
+            ctx.report(self, call,
+                       "print() inside a jit region runs at trace time "
+                       "only, not per step",
+                       hint="use jax.debug.print for per-step output")
+            return
+        if isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not call.args and _is_tainted(f.value, taint):
+            ctx.report(self, call,
+                       ".item() on a traced value inside a jit region")
+            return
+        if name in ("float", "int") and len(call.args) == 1:
+            arg = call.args[0]
+            if _is_tainted(arg, taint) and not _reads_static_attr(arg):
+                ctx.report(self, call,
+                           f"{name}() concretizes a traced value inside "
+                           f"a jit region")
+            return
+        if name and name.split(".")[0] in _NP_MODULES and \
+                last in ("asarray", "array") and call.args and \
+                _is_tainted(call.args[0], taint):
+            ctx.report(self, call,
+                       f"{name}() on a traced value inside a jit region "
+                       f"pulls the tracer to host",
+                       hint="use jnp.asarray (stays on device)")

@@ -1,0 +1,135 @@
+"""hot-loop-sync: host syncs in the train loop's per-iteration body.
+
+Migrated from ``scripts/check_hot_loop.py`` (PR 2), which is now a thin
+shim over this module.  The throughput discipline (PERF.md §1b) allows
+exactly ONE host sync in the hot loop: the tick-boundary fetch inside
+``with span("tick_fetch")``.  Any other ``block_until_ready`` /
+``device_get`` call in a ``while`` loop of a function named ``_train``
+reintroduces a serial host stall per iteration.
+
+This rule complements host-sync-in-jit: the loop body is NOT a jit
+region (it's the host orchestrator), so the tracer-taint rule stays
+quiet there by design — this rule owns the loop-discipline half.
+
+The legacy ``check_source``/``check_file`` entry points (same result
+dict shape: ``{ok, checked, violations}``) are kept here so the script
+shim and its existing callers (tests/test_device_prefetch.py) work
+unchanged — including the "no while loop found in the default target"
+hard failure that guards against the lint target silently moving.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+
+BANNED = {"block_until_ready", "device_get"}
+SANCTIONED_SPAN = "tick_fetch"
+
+_DEFAULT_TARGET = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "train", "loop.py")
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_sanctioned_with(node: ast.With) -> bool:
+    """``with span("tick_fetch")`` (possibly among other items)."""
+    for item in node.items:
+        e = item.context_expr
+        if isinstance(e, ast.Call) and _call_name(e) == "span" and \
+                e.args and isinstance(e.args[0], ast.Constant) and \
+                e.args[0].value == SANCTIONED_SPAN:
+            return True
+    return False
+
+
+def _scan(node: ast.AST, sanctioned: bool, violations: List[dict]) -> None:
+    """Recursive walk tracking whether we are under a sanctioned with."""
+    for child in ast.iter_child_nodes(node):
+        child_ok = sanctioned
+        if isinstance(child, ast.With) and _is_sanctioned_with(child):
+            child_ok = True
+        if isinstance(child, ast.Call):
+            name = _call_name(child)
+            if name in BANNED and not sanctioned:
+                violations.append({"line": child.lineno,
+                                   "col": child.col_offset,
+                                   "call": name})
+        _scan(child, child_ok, violations)
+
+
+def _scan_train(fn: ast.AST) -> List[dict]:
+    """Violations in every ``while`` loop of one ``_train`` def.
+    Scanning the While node covers its condition AND its body (a
+    device_get in the while test would sync every iteration too)."""
+    violations: List[dict] = []
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.While):
+            _scan(sub, False, violations)
+    return violations
+
+
+@register
+class HotLoopSync(Rule):
+    id = "hot-loop-sync"
+    description = ("block_until_ready/device_get in the per-iteration "
+                   "while body of _train outside the sanctioned "
+                   "span(\"tick_fetch\") block")
+    hint = ("move the sync into the tick-boundary span(\"tick_fetch\") "
+            "block, or use copy_to_host_async (non-blocking)")
+    node_types = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def check(self, node: ast.AST, ctx: FileContext) -> None:
+        if node.name != "_train":
+            return
+        for v in _scan_train(node):
+            ctx.report(self, (v["line"], v["col"]),
+                       f"{v['call']}() in the hot loop outside "
+                       f"span(\"{SANCTIONED_SPAN}\") — one host stall "
+                       f"per iteration")
+
+
+# -- legacy entry points (scripts/check_hot_loop.py shim) --------------------
+
+def check_source(src: str) -> dict:
+    """{ok, checked, violations} for one loop.py-shaped source string —
+    the pre-framework result shape, kept for the script shim."""
+    tree = ast.parse(src)
+    loops: List[ast.While] = []
+    violations: List[dict] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node.name == "_train":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.While):
+                    loops.append(sub)
+    for loop in loops:
+        _scan(loop, False, violations)
+    return {"ok": not violations,
+            "checked": len(loops),
+            "violations": [{"line": v["line"], "call": v["call"]}
+                           for v in violations]}
+
+
+def check_file(path: str) -> dict:
+    with open(path) as f:
+        out = check_source(f.read())
+    out["path"] = path
+    if out["checked"] == 0:
+        out["ok"] = False
+        out["violations"] = [
+            {"line": 0, "call": f"no while loop found inside _train in "
+                                f"{path} — lint target moved?"}]
+    return out

@@ -1,0 +1,267 @@
+"""rng-key-reuse: one PRNG key consumed by two or more calls.
+
+JAX keys are values, not stateful generators: passing the same key to
+two sampling calls silently yields *correlated* randomness (the classic
+"my style-mixing latents equal my noise" bug — invisible at runtime, it
+just degrades the model).  The rule tracks, per function scope:
+
+* **key variables** — parameters whose names look like keys (``rng``,
+  ``key``, ``*_rng``, ``*_key``, ``rng_*``, ``key_*``), names assigned
+  from ``PRNGKey`` / ``split`` / ``fold_in`` / ``core.rng`` helpers, and
+  aliases of either;
+* **derivations** — passing a key to ``split`` / ``fold_in`` (and the
+  ``core.rng`` wrappers) does NOT consume it; that's how new streams
+  are minted;
+* **consumptions** — a key appearing anywhere in the arguments of any
+  other call.
+
+Two consumptions of the same variable without an intervening rebinding
+flag the second call site.  Control flow is honored: ``if``/``else``
+branches are analyzed independently and merged (a consumption in each
+exclusive branch does not flag); ``for``/``while`` bodies are scanned
+twice so a key defined OUTSIDE the loop but consumed INSIDE it — fresh
+reuse every iteration — is caught.  Intentional reuse (e.g. shared
+synthesis noise across a PPL pair) gets an inline suppression with a
+justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+from gansformer_tpu.analysis.jit_regions import dotted_name
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_KEY_NAME = re.compile(r"^(rng|key)$|^(rng|key)_|_(rng|key)$")
+# jax.random derivations (need a random-flavored prefix: a bare
+# ``line.split()`` is a *string* split, not a PRNG one)
+_JAX_DERIVERS = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                 "wrap_key_data", "clone"}
+# this repo's core.rng helpers — distinctive enough to match bare
+_RNG_HELPERS = {"key_for", "per_step", "per_host", "split_named"}
+
+
+def _is_key_name(name: str) -> bool:
+    return bool(_KEY_NAME.search(name))
+
+
+def _is_derive_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    parts = name.split(".")
+    last = parts[-1]
+    if last in _RNG_HELPERS:
+        return True
+    if last not in _JAX_DERIVERS:
+        return False
+    if last == "PRNGKey":
+        return True
+    prefix = parts[:-1]
+    # jax.random.split / random.split / jr.split / bare `split` (from
+    # jax.random import split); "line.split" has prefix ["line"] — no.
+    return (not prefix or "random" in prefix
+            or prefix[-1] in ("jr", "jrandom", "rng"))
+
+
+def _is_key_source(expr: ast.AST, state: Dict[str, int]) -> bool:
+    """Does this value expression produce a key?  PRNGKey/split/fold_in
+    results (possibly subscripted), or an alias of a known key."""
+    if isinstance(expr, ast.Call):
+        return _is_derive_call(expr)
+    if isinstance(expr, (ast.Subscript, ast.Starred)):
+        return _is_key_source(expr.value, state)
+    if isinstance(expr, ast.Name):
+        return expr.id in state
+    return False
+
+
+def _imports_jax(tree: ast.Module) -> bool:
+    """Key-looking *parameters* only seed in files that can actually
+    mint JAX keys — spares 'key' dict-loop vars in pure-stdlib files."""
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in n.names):
+                return True
+        elif isinstance(n, ast.ImportFrom) and n.module and (
+                n.module == "jax" or n.module.startswith("jax.")
+                or n.module.endswith("core.rng")):
+            return True
+    return False
+
+
+@register
+class RngKeyReuse(Rule):
+    id = "rng-key-reuse"
+    description = ("a PRNG key passed to >= 2 consuming calls without an "
+                   "intervening split/fold_in")
+    hint = ("split the key (k1, k2 = jax.random.split(key)) or fold_in a "
+            "distinct constant per consumer")
+    node_types = _FUNC_DEFS
+
+    def check(self, node: ast.AST, ctx: FileContext) -> None:
+        if not hasattr(ctx, "_rng_imports_jax"):
+            ctx._rng_imports_jax = _imports_jax(ctx.tree)
+        state: Dict[str, int] = ({p: 0 for p in self._key_params(node)}
+                                 if ctx._rng_imports_jax else {})
+        self._scan_block(node.body, state, ctx)
+
+    # -- scope setup ---------------------------------------------------------
+
+    @staticmethod
+    def _key_params(fn: ast.AST):
+        a = fn.args
+        params = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        return [p.arg for p in params
+                if _is_key_name(p.arg) and p.arg != "rngs"]
+
+    # -- control-flow-aware statement scan -----------------------------------
+
+    def _scan_block(self, stmts, state: Dict[str, int],
+                    ctx: FileContext) -> Dict[str, int]:
+        for st in stmts:
+            if isinstance(st, _FUNC_DEFS + (ast.ClassDef, ast.Lambda)):
+                continue              # separate scope, dispatched on its own
+            if isinstance(st, ast.If):
+                # the condition itself can consume (jax.random.bernoulli)
+                self._scan_stmt_exprs([st.test], state, ctx)
+                s1 = self._scan_block(st.body, dict(state), ctx)
+                s2 = self._scan_block(st.orelse, dict(state), ctx)
+                # a branch that terminates (return/raise/…) contributes
+                # nothing to the fall-through state
+                if self._terminates(st.body):
+                    s1 = dict(state)
+                if st.orelse and self._terminates(st.orelse):
+                    s2 = dict(state)
+                state = self._merge(s1, s2)
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(st, (ast.For, ast.AsyncFor)):
+                    self._scan_stmt_exprs([st.iter], state, ctx)
+                    self._rebind(st.target, st.iter, state)
+                # twice: the 2nd pass sees cross-iteration reuse of keys
+                # bound outside the loop (keys rebound inside stay clean);
+                # a while TEST re-evaluates per iteration, so it scans
+                # before each body pass
+                inner = dict(state)
+                for _ in range(2):
+                    if isinstance(st, ast.While):
+                        self._scan_stmt_exprs([st.test], inner, ctx)
+                    inner = self._scan_block(st.body, inner, ctx)
+                state = self._merge(state, inner)
+                state = self._scan_block(st.orelse, state, ctx)
+            elif isinstance(st, ast.Try):
+                state = self._scan_block(st.body, state, ctx)
+                for h in st.handlers:
+                    state = self._scan_block(h.body, state, ctx)
+                state = self._scan_block(st.orelse, state, ctx)
+                state = self._scan_block(st.finalbody, state, ctx)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                self._scan_stmt_exprs(
+                    [i.context_expr for i in st.items], state, ctx)
+                state = self._scan_block(st.body, state, ctx)
+            else:
+                self._process_stmt(st, state, ctx)
+        return state
+
+    @staticmethod
+    def _terminates(stmts) -> bool:
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+    @staticmethod
+    def _merge(s1: Dict[str, int], s2: Dict[str, int]) -> Dict[str, int]:
+        """Exclusive branches: a key is as used as its worst branch."""
+        return {k: max(s1.get(k, 0), s2.get(k, 0))
+                for k in set(s1) | set(s2)}
+
+    # -- one linear statement ------------------------------------------------
+
+    def _process_stmt(self, st: ast.stmt, state: Dict[str, int],
+                      ctx: FileContext) -> None:
+        if isinstance(st, ast.Assign):
+            self._scan_stmt_exprs([st.value], state, ctx)
+            for t in st.targets:
+                self._rebind(t, st.value, state)
+        elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+            if st.value is not None:
+                self._scan_stmt_exprs([st.value], state, ctx)
+                self._rebind(st.target, st.value, state)
+        else:
+            self._scan_stmt_exprs(
+                [n for n in ast.iter_child_nodes(st)
+                 if isinstance(n, ast.expr)], state, ctx)
+
+    def _rebind(self, target: ast.AST, value: ast.AST,
+                state: Dict[str, int]) -> None:
+        """Assignment: a key-producing value (or any value bound to a
+        key-looking name) starts a FRESH key; other values un-key the
+        name.  Tuple targets of a split are all fresh keys."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._rebind(elt, value, state)
+            return
+        if not isinstance(target, ast.Name):
+            return
+        # provenance required: only PRNG-producing values (or aliases of
+        # known keys) create tracked keys — a key-looking NAME bound to
+        # e.g. np.random.RandomState is a stateful generator, legal to
+        # reuse, and must not be tracked.
+        if _is_key_source(value, state):
+            state[target.id] = 0
+        else:
+            state.pop(target.id, None)
+
+    # -- consumption counting ------------------------------------------------
+
+    def _scan_stmt_exprs(self, exprs, state: Dict[str, int],
+                         ctx: FileContext) -> None:
+        for e in exprs:
+            self._visit_expr(e, state, ctx)
+
+    def _visit_expr(self, e: ast.AST, state: Dict[str, int],
+                    ctx: FileContext) -> None:
+        if isinstance(e, _FUNC_DEFS + (ast.Lambda,)):
+            return
+        if isinstance(e, ast.Call):
+            derive = _is_derive_call(e)
+            self._visit_expr(e.func, state, ctx)
+            for arg in list(e.args) + [kw.value for kw in e.keywords]:
+                if derive and isinstance(arg, ast.Name):
+                    continue          # split(key)/fold_in(key, …): derives
+                if derive and isinstance(arg, ast.Starred) and \
+                        isinstance(arg.value, ast.Name):
+                    continue
+                self._visit_expr(arg, state, ctx)
+            return
+        if isinstance(e, ast.Name) and isinstance(e.ctx, ast.Load) and \
+                e.id in state:
+            if self._inside_call_args(e, ctx):
+                state[e.id] += 1
+                if state[e.id] == 2:
+                    ctx.report(
+                        self, e,
+                        f"PRNG key {e.id!r} passed to a second consuming "
+                        f"call without an intervening split/fold_in — "
+                        f"correlated randomness")
+            return
+        for child in ast.iter_child_nodes(e):
+            self._visit_expr(child, state, ctx)
+
+    @staticmethod
+    def _inside_call_args(name_node: ast.Name, ctx: FileContext) -> bool:
+        """Only uses that hand the key to a call consume entropy (a bare
+        ``return key`` or comparison does not)."""
+        n = name_node
+        while True:
+            parent = ctx.parent(n)
+            if parent is None or isinstance(parent, ast.stmt):
+                return False
+            if isinstance(parent, ast.Call):
+                # ``key.method(...)``: the key is the callee (a stateful-
+                # generator idiom), not an argument — no entropy handed over
+                return n is not parent.func
+            n = parent

@@ -1,0 +1,123 @@
+"""telemetry-name-convention: instrument names must be ``group/name``.
+
+docs/observability.md documents the registry namespace: slash-separated
+lowercase paths (``data/prefetch_queue_depth``, ``ckpt/write_ms``,
+``metric/<name>/duration_s``) that export to Prometheus as
+``group_name``.  A free-form name (``"MyCounter"``, ``"data wait"``)
+still *works* — and then lands in telemetry.prom outside every dashboard
+group and grep.  This rule pins the convention at review time.
+
+Checked call sites (resolved from the file's imports so unrelated
+``.counter()`` methods don't false-positive):
+
+* ``counter/gauge/histogram`` imported bare from
+  ``gansformer_tpu.obs.registry`` (or ``…obs``);
+* the same attributes on a module imported as an alias
+  (``from gansformer_tpu.obs import registry as telemetry``);
+* the same attributes on ``get_registry()`` / ``obs.get_registry()``.
+
+Constant names must match ``^[a-z0-9_]+(/[a-z0-9_]+)+$`` (at least one
+slash: a group and a name).  f-strings are checked on their constant
+fragments only (charset + at least structural plausibility); fully
+dynamic names are skipped — the runtime Prometheus sanitizer and the
+schema lint (telemetry_schema.py) own that half.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Set
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+from gansformer_tpu.analysis.jit_regions import dotted_name
+
+_INSTRUMENTS = {"counter", "gauge", "histogram"}
+_OBS_MODULES = ("gansformer_tpu.obs.registry", "gansformer_tpu.obs")
+_NAME_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_]+)+$")
+_FRAGMENT_RE = re.compile(r"^[a-z0-9_/]*$")
+
+
+@register
+class TelemetryNameConvention(Rule):
+    id = "telemetry-name-convention"
+    description = ("telemetry counter/gauge/histogram names must follow "
+                   "the group/name pattern from docs/observability.md")
+    hint = ("use a slash-separated lowercase path, e.g. "
+            "\"data/wait_ms\" or \"ckpt/save_total\"")
+    node_types = (ast.Module,)
+
+    def check(self, node: ast.Module, ctx: FileContext) -> None:
+        bare, module_aliases = self._aliases(node)
+        if not bare and not module_aliases:
+            return
+        for call in ast.walk(node):
+            if isinstance(call, ast.Call) and \
+                    self._is_instrument_call(call, bare, module_aliases):
+                self._check_name(call, ctx)
+
+    # -- import resolution ---------------------------------------------------
+
+    @staticmethod
+    def _aliases(tree: ast.Module):
+        """(bare instrument fn names, module alias names) imported from
+        the obs registry in this file."""
+        bare: Set[str] = set()
+        modules: Set[str] = set()
+        for n in ast.walk(tree):
+            if isinstance(n, ast.ImportFrom) and n.module and \
+                    n.module.startswith("gansformer_tpu.obs"):
+                for a in n.names:
+                    local = a.asname or a.name
+                    if a.name in _INSTRUMENTS:
+                        bare.add(local)
+                    elif a.name in ("registry", "obs"):
+                        modules.add(local)
+            elif isinstance(n, ast.Import):
+                for a in n.names:
+                    if a.name in _OBS_MODULES:
+                        modules.add(a.asname or a.name.split(".")[0])
+        return bare, modules
+
+    @staticmethod
+    def _is_instrument_call(call: ast.Call, bare: Set[str],
+                            modules: Set[str]) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return f.id in bare
+        if isinstance(f, ast.Attribute) and f.attr in _INSTRUMENTS:
+            base = dotted_name(f.value)
+            if base and (base in modules
+                         or base.split(".")[0] in modules):
+                return True
+            # get_registry().counter(...)
+            if isinstance(f.value, ast.Call):
+                inner = dotted_name(f.value.func)
+                return bool(inner) and \
+                    inner.split(".")[-1] == "get_registry"
+        return False
+
+    # -- the convention itself ----------------------------------------------
+
+    def _check_name(self, call: ast.Call, ctx: FileContext) -> None:
+        if not call.args:
+            return
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if not _NAME_RE.match(arg.value):
+                ctx.report(
+                    self, arg,
+                    f"telemetry name {arg.value!r} does not match the "
+                    f"group/name convention "
+                    f"([a-z0-9_]+(/[a-z0-9_]+)+, docs/observability.md)")
+        elif isinstance(arg, ast.JoinedStr):
+            frags = "".join(v.value for v in arg.values
+                            if isinstance(v, ast.Constant)
+                            and isinstance(v.value, str))
+            if not _FRAGMENT_RE.match(frags):
+                ctx.report(
+                    self, arg,
+                    f"telemetry f-string name has non-conforming constant "
+                    f"fragments {frags!r} (want lowercase [a-z0-9_/], "
+                    f"docs/observability.md)")
+        # fully dynamic names: runtime sanitizer + schema lint own those

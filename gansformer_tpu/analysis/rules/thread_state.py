@@ -1,0 +1,182 @@
+"""thread-shared-state: background threads mutating module state unlocked.
+
+The overlap layer (utils/background.py, data/device_prefetch.py) runs
+real work on ``threading.Thread`` targets.  Instance state those threads
+touch is protected by each class's lock; what nothing protects is
+*module-level* mutable state — a module dict used as a cache, a list
+used as a log — mutated from a thread target while the main thread
+reads it.  CPython's GIL makes most such races "work" until a compound
+update tears under a tick boundary.
+
+The rule finds, per module:
+
+* **module-level mutables** — top-level names assigned list/dict/set
+  literals or comprehensions;
+* **thread targets** — functions/methods passed as ``target=`` to a
+  ``Thread(...)`` call (bare names resolve to defs in the file,
+  ``self.X`` to the method of the enclosing class);
+
+and flags any mutation of a module-level mutable inside a thread
+target's body — ``x[...] = …``, ``x.append/update/…(...)``, or a
+``global`` rebind — unless the statement sits lexically inside a
+``with <…lock…>:`` block (any context expression whose dotted name
+contains "lock", e.g. ``self._lock``, ``_CACHE_LOCK``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+from gansformer_tpu.analysis.jit_regions import dotted_name
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "appendleft",
+             "popleft"}
+
+
+def _module_mutables(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for st in tree.body:
+        if isinstance(st, ast.Assign) and \
+                isinstance(st.value, _MUTABLE_LITERALS):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None and \
+                isinstance(st.value, _MUTABLE_LITERALS) and \
+                isinstance(st.target, ast.Name):
+            out.add(st.target.id)
+    return out
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    name = dotted_name(expr)
+    if not name and isinstance(expr, ast.Call):
+        name = dotted_name(expr.func)
+    return "lock" in name.lower()
+
+
+def _enclosing_class(node: ast.AST, ctx: FileContext):
+    n = node
+    while n is not None:
+        if isinstance(n, ast.ClassDef):
+            return n
+        n = ctx.parent(n)
+    return None
+
+
+@register
+class ThreadSharedState(Rule):
+    id = "thread-shared-state"
+    description = ("module-level mutable state mutated from a "
+                   "threading.Thread target without holding a lock")
+    hint = ("guard the mutation with the owning class's lock "
+            "(with self._lock: …) or move the state onto the instance")
+    node_types = (ast.Module,)
+
+    def check(self, node: ast.Module, ctx: FileContext) -> None:
+        mutables = _module_mutables(node)
+        if not mutables:
+            return
+        for target_fn in self._thread_targets(node, ctx):
+            self._scan(target_fn, mutables, False, ctx)
+
+    # -- find thread target defs --------------------------------------------
+
+    def _thread_targets(self, tree: ast.Module,
+                        ctx: FileContext) -> List[ast.AST]:
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for n in ast.walk(tree):
+            if isinstance(n, _FUNC_DEFS):
+                defs_by_name.setdefault(n.name, []).append(n)
+        targets: List[ast.AST] = []
+        seen: Set[int] = set()
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted_name(call.func)
+            if not name or name.split(".")[-1] != "Thread":
+                continue
+            for kw in call.keywords:
+                if kw.arg != "target":
+                    continue
+                v = kw.value
+                cands: List[ast.AST] = []
+                if isinstance(v, ast.Name):
+                    cands = defs_by_name.get(v.id, [])
+                elif isinstance(v, ast.Attribute) and \
+                        isinstance(v.value, ast.Name) and \
+                        v.value.id == "self":
+                    cls = _enclosing_class(call, ctx)
+                    if cls is not None:
+                        cands = [m for m in cls.body
+                                 if isinstance(m, _FUNC_DEFS)
+                                 and m.name == v.attr]
+                for c in cands:
+                    if id(c) not in seen:
+                        seen.add(id(c))
+                        targets.append(c)
+        return targets
+
+    # -- scan a target body, tracking lexical lock scope --------------------
+
+    def _scan(self, node: ast.AST, mutables: Set[str], locked: bool,
+              ctx: FileContext) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_locked = locked
+            if isinstance(child, (ast.With, ast.AsyncWith)) and \
+                    any(_is_lock_expr(i.context_expr) for i in child.items):
+                child_locked = True
+            if not locked:
+                self._check_stmt(child, mutables, ctx)
+            self._scan(child, mutables, child_locked, ctx)
+
+    def _check_stmt(self, node: ast.AST, mutables: Set[str],
+                    ctx: FileContext) -> None:
+        # x[...] = ...  /  x[...] += ...
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in mutables:
+                    ctx.report(
+                        self, node,
+                        f"module-level mutable {t.value.id!r} written "
+                        f"from a thread target without holding a lock")
+            # global x; x = ...  (rebind of a module mutable)
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in mutables and \
+                        self._declared_global(node, t.id, ctx):
+                    ctx.report(
+                        self, node,
+                        f"module-level mutable {t.id!r} rebound from a "
+                        f"thread target without holding a lock")
+        # x.append(...) etc.
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in mutables:
+            ctx.report(
+                self, node,
+                f"module-level mutable {node.func.value.id!r}."
+                f"{node.func.attr}() from a thread target without "
+                f"holding a lock")
+
+    @staticmethod
+    def _declared_global(node: ast.AST, name: str,
+                         ctx: FileContext) -> bool:
+        n = node
+        while n is not None and not isinstance(n, _FUNC_DEFS):
+            n = ctx.parent(n)
+        if n is None:
+            return False
+        return any(isinstance(s, ast.Global) and name in s.names
+                   for s in ast.walk(n))

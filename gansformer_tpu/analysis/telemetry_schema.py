@@ -1,0 +1,181 @@
+"""Run-dir telemetry artifact lint (migrated from scripts/check_telemetry.py).
+
+The non-AST member of the analysis family: validates what a real (smoke)
+run actually wrote —
+
+* ``events.jsonl`` — every line is a Chrome-trace complete event:
+  ``name`` str, ``ph`` == "X", numeric non-negative ``ts``/``dur``,
+  integer ``pid``/``tid``.
+* ``telemetry.prom`` — Prometheus text exposition: well-formed
+  ``# TYPE <name> <kind>`` comments, every sample line
+  ``<legal_name> <float>``, and every sample's family declared by a
+  preceding TYPE line (``_count``/``_sum``/``_min``/``_max`` suffixes
+  resolve to their summary family).
+* ``heartbeat-p*.json`` — required keys with sane types.
+
+``check_events``/``check_prom``/``check_heartbeat``/``check_run_dir``
+keep the pre-framework API (the script shim and tests/test_obs.py call
+them directly); ``lint_run_dir`` adapts the same errors into ``Finding``
+objects so the ``gansformer-lint --run-dir`` path reports through the
+shared reporters.  This lint pairs with the AST-side
+telemetry-name-convention rule: that one pins the *source* names, this
+one the *artifact* schema.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import List
+
+from gansformer_tpu.analysis.findings import Finding
+
+PROM_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+PROM_TYPES = {"counter", "gauge", "summary", "histogram", "untyped"}
+EVENT_KEYS = {"name": str, "ph": str, "ts": (int, float),
+              "dur": (int, float), "pid": int, "tid": int}
+HEARTBEAT_KEYS = {"process": int, "pid": int, "host": str,
+                  "time": (int, float), "step": int, "kimg": (int, float)}
+
+
+def check_events(path: str) -> List[str]:
+    errors = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError as e:
+                errors.append(f"{path}:{i}: not JSON ({e})")
+                continue
+            for key, typ in EVENT_KEYS.items():
+                if key not in ev:
+                    errors.append(f"{path}:{i}: missing {key!r}")
+                elif not isinstance(ev[key], typ) or \
+                        isinstance(ev[key], bool):
+                    errors.append(
+                        f"{path}:{i}: {key}={ev[key]!r} is not {typ}")
+            if ev.get("ph") != "X":
+                errors.append(f"{path}:{i}: ph={ev.get('ph')!r} "
+                              f"(expected complete event 'X')")
+            for key in ("ts", "dur"):
+                if isinstance(ev.get(key), (int, float)) and ev[key] < 0:
+                    errors.append(f"{path}:{i}: negative {key}")
+    return errors
+
+
+def check_prom(path: str) -> List[str]:
+    errors = []
+    declared = set()
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split()
+                if len(parts) >= 2 and parts[1] == "TYPE":
+                    if len(parts) != 4 or not PROM_NAME.match(parts[2]) \
+                            or parts[3] not in PROM_TYPES:
+                        errors.append(f"{path}:{i}: malformed TYPE line")
+                    else:
+                        declared.add(parts[2])
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                errors.append(f"{path}:{i}: expected '<name> <value>'")
+                continue
+            name, value = parts
+            base = name.split("{")[0]
+            if not PROM_NAME.match(base):
+                errors.append(f"{path}:{i}: illegal metric name {base!r}")
+            try:
+                float(value)
+            except ValueError:
+                errors.append(f"{path}:{i}: non-numeric value {value!r}")
+            family = re.sub(r"_(count|sum|min|max)$", "", base)
+            if base not in declared and family not in declared:
+                errors.append(f"{path}:{i}: sample {base!r} has no "
+                              f"preceding # TYPE declaration")
+    return errors
+
+
+def check_heartbeat(path: str) -> List[str]:
+    errors = []
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except ValueError as e:
+        return [f"{path}: not JSON ({e})"]
+    for key, typ in HEARTBEAT_KEYS.items():
+        if key not in rec:
+            errors.append(f"{path}: missing {key!r}")
+        elif not isinstance(rec[key], typ) or isinstance(rec[key], bool):
+            errors.append(f"{path}: {key}={rec[key]!r} is not {typ}")
+    return errors
+
+
+def check_run_dir(run_dir: str) -> dict:
+    """{ok, checked, errors} over every telemetry artifact present.
+    A MISSING artifact is an error — the lint runs against a smoke run
+    that must have produced all of them."""
+    errors: List[str] = []
+    checked: List[str] = []
+    for fname, checker in (("events.jsonl", check_events),
+                           ("telemetry.prom", check_prom)):
+        path = os.path.join(run_dir, fname)
+        if not os.path.exists(path):
+            errors.append(f"{path}: missing")
+            continue
+        checked.append(fname)
+        errors += checker(path)
+    beats = sorted(glob.glob(os.path.join(run_dir, "heartbeat-p*.json")))
+    if not beats:
+        errors.append(f"{run_dir}: no heartbeat-p*.json")
+    for path in beats:
+        checked.append(os.path.basename(path))
+        errors += check_heartbeat(path)
+    return {"ok": not errors, "checked": checked, "errors": errors}
+
+
+_ERR_LOC = re.compile(r"^(?P<path>.+?):(?P<line>\d+): (?P<msg>.*)$")
+
+
+def lint_run_dir(run_dir: str) -> List[Finding]:
+    """The same schema errors as ``check_run_dir``, as Findings (rule id
+    ``telemetry-schema``) for the shared reporters."""
+    out: List[Finding] = []
+    for err in check_run_dir(run_dir)["errors"]:
+        m = _ERR_LOC.match(err)
+        if m:
+            out.append(Finding(rule="telemetry-schema",
+                               path=m.group("path"),
+                               line=int(m.group("line")), col=0,
+                               message=m.group("msg")))
+        else:
+            path, _, msg = err.partition(": ")
+            out.append(Finding(rule="telemetry-schema", path=path or run_dir,
+                               line=0, col=0, message=msg or err))
+    return out
+
+
+def main(argv=None) -> int:
+    """Legacy CLI: ``python -m …telemetry_schema <run_dir>`` — one JSON
+    line {ok, checked, errors}; exit 0 iff ok (the script shim's
+    contract)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Schema lint for a run dir's telemetry artifacts")
+    p.add_argument("run_dir")
+    args = p.parse_args(argv)
+    result = check_run_dir(args.run_dir)
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -421,7 +421,9 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                             "label" in batch_list[0]
                             else None)
                 with span("step"):
-                    state, sums = fns.cycle(state, imgs_k, base_rng, it,
+                    # base_rng is the cycle's API: it folds in the global
+                    # iteration index per contained step itself
+                    state, sums = fns.cycle(state, imgs_k, base_rng, it,  # graftlint: disable=rng-key-reuse
                                             label_k)
                     it += k_cycle
                     cur_nimg += t.batch_size * k_cycle

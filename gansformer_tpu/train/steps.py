@@ -328,7 +328,9 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
         wb = w0 + (w1 - w0) * (tt + epsilon)
         img_a = G.apply({"params": params}, wa, rngs={"noise": rng},
                         method=Generator.synthesize)
-        img_b = G.apply({"params": params}, wb, rngs={"noise": rng},
+        # same key on purpose: PPL measures the w-space perturbation alone,
+        # so the pair must share its synthesis noise
+        img_b = G.apply({"params": params}, wb, rngs={"noise": rng},  # graftlint: disable=rng-key-reuse
                         method=Generator.synthesize)
         return img_a, img_b
 
@@ -370,12 +372,12 @@ def make_metric_samplers(fns: TrainStepFns, state, cfg: ExperimentConfig,
     # way to build a multi-host array (VERDICT r3 weak #3).
 
     def sample_fn(n):
-        rng_holder[0], k1, k2 = jax.random.split(rng_holder[0], 3)
+        rng_holder[0], k1, k2, k3 = jax.random.split(rng_holder[0], 4)
         m = n + (-n) % env.data_size          # pad to mesh divisibility
         z = env.put_global(jax.random.normal(
             k1, (m, cfg.model.num_ws, cfg.model.latent_dim)))
         label = (dataset.random_labels(
-            m, seed=int(jax.random.randint(k1, (), 0, 2**30)))
+            m, seed=int(jax.random.randint(k3, (), 0, 2**30)))
             if cfg.model.label_dim else None)
         if label is not None:
             label = env.put_global(label)

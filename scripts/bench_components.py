@@ -140,8 +140,9 @@ def main() -> None:
     noise = {"noise": jax.random.PRNGKey(1)}
 
     t0 = time.time()
-    g_vars = jax.jit(lambda k: G.init({"params": k, **noise}, z))(key)
-    d_vars = jax.jit(lambda k: D.init(k, imgs))(key)
+    kg, kd = jax.random.split(key)
+    g_vars = jax.jit(lambda k: G.init({"params": k, **noise}, z))(kg)
+    d_vars = jax.jit(lambda k: D.init(k, imgs))(kd)
     jax.block_until_ready((g_vars, d_vars))
     print(json.dumps({"name": "init", "s": round(time.time() - t0, 1)}),
           flush=True)

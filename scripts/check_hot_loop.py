@@ -1,115 +1,36 @@
 """Static lint: no host syncs inside the train loop's per-iteration body.
 
-The throughput discipline (PERF.md §1b, ISSUE 2) allows exactly ONE host
-sync in the hot loop: the tick-boundary fetch inside the
-``with span("tick_fetch")`` block.  Everything else must be dispatch-only
-— a stray ``jax.block_until_ready`` / ``jax.device_get`` anywhere else in
-the iteration body reintroduces a serial host stall per iteration, the
-exact regression the device-prefetch / async-writeback layer exists to
-prevent.  (``copy_to_host_async`` is non-blocking and therefore allowed.)
-
-Mechanically: parse ``gansformer_tpu/train/loop.py``, find the ``while``
-loop inside ``_train`` (the per-iteration body), and flag any call whose
-name is ``block_until_ready`` or ``device_get`` that is not lexically
-inside a ``with span("tick_fetch")`` block.  Function *definitions*
-nested in ``_train`` but outside the while body (``snapshot_images`` —
-the sync fallback path) are exempt by construction.
-
-Prints one JSON line ``{ok, checked, violations}``; exit 0 iff ok.
-Invoked from the test suite (tests/test_device_prefetch.py) like
-``check_telemetry.py``, so a hot-loop sync regression fails tier-1.
+SHIM — the checker now lives in the graftlint framework as the
+``hot-loop-sync`` rule (``gansformer_tpu/analysis/rules/hot_loop.py``,
+ISSUE 3); this script keeps the original entry point and module API
+(``check_source`` / ``check_file`` / ``_DEFAULT_TARGET``, result shape
+``{ok, checked, violations}``) so existing invocations and the verify
+recipe keep working:
 
   python scripts/check_hot_loop.py [path/to/loop.py]
+
+Prefer ``gansformer-lint --select hot-loop-sync gansformer_tpu`` for new
+wiring; see docs/static-analysis.md.
 """
 
 from __future__ import annotations
 
 import argparse
-import ast
 import json
 import os
 import sys
-from typing import List, Optional
 
-BANNED = {"block_until_ready", "device_get"}
-SANCTIONED_SPAN = "tick_fetch"
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:          # direct `python scripts/…` invocation
+    sys.path.insert(0, _ROOT)
 
-_DEFAULT_TARGET = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "gansformer_tpu", "train", "loop.py")
-
-
-def _call_name(node: ast.Call) -> Optional[str]:
-    f = node.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    if isinstance(f, ast.Name):
-        return f.id
-    return None
-
-
-def _is_sanctioned_with(node: ast.With) -> bool:
-    """``with span("tick_fetch")`` (possibly among other items)."""
-    for item in node.items:
-        e = item.context_expr
-        if isinstance(e, ast.Call) and _call_name(e) == "span" and \
-                e.args and isinstance(e.args[0], ast.Constant) and \
-                e.args[0].value == SANCTIONED_SPAN:
-            return True
-    return False
-
-
-def _find_hot_loops(tree: ast.AST) -> List[ast.While]:
-    """Every ``while`` statement inside a function named ``_train``."""
-    loops: List[ast.While] = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == "_train":
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.While):
-                    loops.append(sub)
-    return loops
-
-
-def _scan(node: ast.AST, sanctioned: bool, violations: List[dict]) -> None:
-    """Recursive walk tracking whether we are under a sanctioned with."""
-    for child in ast.iter_child_nodes(node):
-        child_ok = sanctioned
-        if isinstance(child, ast.With) and _is_sanctioned_with(child):
-            child_ok = True
-        if isinstance(child, ast.Call):
-            name = _call_name(child)
-            if name in BANNED and not sanctioned:
-                violations.append({
-                    "line": child.lineno,
-                    "call": name,
-                })
-        _scan(child, child_ok, violations)
-
-
-def check_source(src: str) -> dict:
-    """{ok, checked, violations} for one loop.py-shaped source string."""
-    tree = ast.parse(src)
-    loops = _find_hot_loops(tree)
-    violations: List[dict] = []
-    for loop in loops:
-        # scanning the While node covers its condition AND its body (a
-        # device_get in the while test would sync every iteration too)
-        _scan(loop, False, violations)
-    return {"ok": not violations,
-            "checked": len(loops),
-            "violations": violations}
-
-
-def check_file(path: str) -> dict:
-    with open(path) as f:
-        out = check_source(f.read())
-    out["path"] = path
-    if out["checked"] == 0:
-        out["ok"] = False
-        out["violations"] = [
-            {"line": 0, "call": f"no while loop found inside _train in "
-                                f"{path} — lint target moved?"}]
-    return out
+from gansformer_tpu.analysis.rules.hot_loop import (  # noqa: E402,F401
+    BANNED,
+    SANCTIONED_SPAN,
+    _DEFAULT_TARGET,
+    check_file,
+    check_source,
+)
 
 
 def main(argv=None) -> int:

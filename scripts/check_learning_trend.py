@@ -98,7 +98,10 @@ def check(run_dir: str, metric: str | None, min_points: int,
     return out
 
 
-def main() -> None:
+def main(argv=None) -> int:
+    # argv-parameterized and side-effect-free on import, so the analysis
+    # test suite can import and drive every script it shims (ISSUE 3):
+    # parse_args/sys.exit only run under __main__ or an explicit call.
     p = argparse.ArgumentParser()
     p.add_argument("run_dir")
     p.add_argument("--metric", default=None,
@@ -106,11 +109,11 @@ def main() -> None:
     p.add_argument("--min-points", type=int, default=3)
     p.add_argument("--min-drop", type=float, default=0.10,
                    help="required relative drop of the fitted line")
-    args = p.parse_args()
+    args = p.parse_args(argv)
     out = check(args.run_dir, args.metric, args.min_points, args.min_drop)
     print(json.dumps(out))
-    sys.exit(0 if out["ok"] else 1)
+    return 0 if out["ok"] else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

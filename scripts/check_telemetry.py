@@ -1,151 +1,38 @@
 """Schema lint for a run dir's telemetry artifacts (ISSUE 1 CI task).
 
-Validates, from a real (smoke) run:
-
-* ``events.jsonl`` — every line is a Chrome-trace complete event:
-  ``name`` str, ``ph`` == "X", numeric non-negative ``ts``/``dur``,
-  integer ``pid``/``tid``.
-* ``telemetry.prom`` — Prometheus text exposition: well-formed
-  ``# TYPE <name> <kind>`` comments, every sample line
-  ``<legal_name> <float>``, and every sample's family declared by a
-  preceding TYPE line (``_count``/``_sum``/``_min``/``_max`` suffixes
-  resolve to their summary family).
-* ``heartbeat-p*.json`` — required keys with sane types.
-
-Prints one JSON line ``{ok, checked, errors}``; exit 0 iff ok.  Invoked
-from the test suite (tests/test_obs.py) against the shared micro
-training run, so the tier-1 command exercises the whole schema.
+SHIM — the checker now lives in the graftlint framework
+(``gansformer_tpu/analysis/telemetry_schema.py``, ISSUE 3); this script
+keeps the original entry point and module API (``check_events`` /
+``check_prom`` / ``check_heartbeat`` / ``check_run_dir``, result shape
+``{ok, checked, errors}``) so existing invocations (tests/test_obs.py,
+the verify recipe) keep working:
 
   python scripts/check_telemetry.py <run_dir>
+
+Prefer ``gansformer-lint --run-dir <run_dir>`` for new wiring; see
+docs/static-analysis.md.
 """
 
 from __future__ import annotations
 
-import argparse
-import glob
-import json
 import os
-import re
 import sys
-from typing import List
 
-PROM_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-PROM_TYPES = {"counter", "gauge", "summary", "histogram", "untyped"}
-EVENT_KEYS = {"name": str, "ph": str, "ts": (int, float),
-              "dur": (int, float), "pid": int, "tid": int}
-HEARTBEAT_KEYS = {"process": int, "pid": int, "host": str,
-                  "time": (int, float), "step": int, "kimg": (int, float)}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:          # direct `python scripts/…` invocation
+    sys.path.insert(0, _ROOT)
 
-
-def check_events(path: str) -> List[str]:
-    errors = []
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            if not line.strip():
-                continue
-            try:
-                ev = json.loads(line)
-            except ValueError as e:
-                errors.append(f"{path}:{i}: not JSON ({e})")
-                continue
-            for key, typ in EVENT_KEYS.items():
-                if key not in ev:
-                    errors.append(f"{path}:{i}: missing {key!r}")
-                elif not isinstance(ev[key], typ) or \
-                        isinstance(ev[key], bool):
-                    errors.append(
-                        f"{path}:{i}: {key}={ev[key]!r} is not {typ}")
-            if ev.get("ph") != "X":
-                errors.append(f"{path}:{i}: ph={ev.get('ph')!r} "
-                              f"(expected complete event 'X')")
-            for key in ("ts", "dur"):
-                if isinstance(ev.get(key), (int, float)) and ev[key] < 0:
-                    errors.append(f"{path}:{i}: negative {key}")
-    return errors
-
-
-def check_prom(path: str) -> List[str]:
-    errors = []
-    declared = set()
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            line = line.rstrip("\n")
-            if not line.strip():
-                continue
-            if line.startswith("#"):
-                parts = line.split()
-                if len(parts) >= 2 and parts[1] == "TYPE":
-                    if len(parts) != 4 or not PROM_NAME.match(parts[2]) \
-                            or parts[3] not in PROM_TYPES:
-                        errors.append(f"{path}:{i}: malformed TYPE line")
-                    else:
-                        declared.add(parts[2])
-                continue
-            parts = line.split()
-            if len(parts) != 2:
-                errors.append(f"{path}:{i}: expected '<name> <value>'")
-                continue
-            name, value = parts
-            base = name.split("{")[0]
-            if not PROM_NAME.match(base):
-                errors.append(f"{path}:{i}: illegal metric name {base!r}")
-            try:
-                float(value)
-            except ValueError:
-                errors.append(f"{path}:{i}: non-numeric value {value!r}")
-            family = re.sub(r"_(count|sum|min|max)$", "", base)
-            if base not in declared and family not in declared:
-                errors.append(f"{path}:{i}: sample {base!r} has no "
-                              f"preceding # TYPE declaration")
-    return errors
-
-
-def check_heartbeat(path: str) -> List[str]:
-    errors = []
-    try:
-        with open(path) as f:
-            rec = json.load(f)
-    except ValueError as e:
-        return [f"{path}: not JSON ({e})"]
-    for key, typ in HEARTBEAT_KEYS.items():
-        if key not in rec:
-            errors.append(f"{path}: missing {key!r}")
-        elif not isinstance(rec[key], typ) or isinstance(rec[key], bool):
-            errors.append(f"{path}: {key}={rec[key]!r} is not {typ}")
-    return errors
-
-
-def check_run_dir(run_dir: str) -> dict:
-    """{ok, checked, errors} over every telemetry artifact present.
-    A MISSING artifact is an error — the lint runs against a smoke run
-    that must have produced all of them."""
-    errors: List[str] = []
-    checked: List[str] = []
-    for fname, checker in (("events.jsonl", check_events),
-                           ("telemetry.prom", check_prom)):
-        path = os.path.join(run_dir, fname)
-        if not os.path.exists(path):
-            errors.append(f"{path}: missing")
-            continue
-        checked.append(fname)
-        errors += checker(path)
-    beats = sorted(glob.glob(os.path.join(run_dir, "heartbeat-p*.json")))
-    if not beats:
-        errors.append(f"{run_dir}: no heartbeat-p*.json")
-    for path in beats:
-        checked.append(os.path.basename(path))
-        errors += check_heartbeat(path)
-    return {"ok": not errors, "checked": checked, "errors": errors}
-
-
-def main(argv=None) -> int:
-    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("run_dir")
-    args = p.parse_args(argv)
-    result = check_run_dir(args.run_dir)
-    print(json.dumps(result))
-    return 0 if result["ok"] else 1
-
+from gansformer_tpu.analysis.telemetry_schema import (  # noqa: E402,F401
+    EVENT_KEYS,
+    HEARTBEAT_KEYS,
+    PROM_NAME,
+    PROM_TYPES,
+    check_events,
+    check_heartbeat,
+    check_prom,
+    check_run_dir,
+    main,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,244 @@
+"""Tests for the graftlint framework itself (gansformer_tpu/analysis):
+rule registry, single-walk driver, suppression parsing, reporter golden
+output, baseline determinism/consumption, and the CLI contract."""
+
+import json
+import os
+
+from gansformer_tpu.analysis import all_rules, lint_paths, lint_source
+from gansformer_tpu.analysis.baseline import Baseline, line_text_lookup
+from gansformer_tpu.analysis.cli import main as cli_main
+from gansformer_tpu.analysis.engine import iter_python_files
+from gansformer_tpu.analysis.findings import Finding
+from gansformer_tpu.analysis.reporters import render_json, render_text
+
+EXPECTED_RULES = {
+    "host-sync-in-jit", "donation-after-use", "rng-key-reuse",
+    "hot-loop-sync", "thread-shared-state", "telemetry-name-convention",
+}
+
+BAD_RNG = """\
+import jax
+
+def f(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))
+    return a + b
+"""
+
+
+# --- registry / engine ------------------------------------------------------
+
+def test_registry_contains_the_six_rules():
+    ids = {r.id for r in all_rules()}
+    assert EXPECTED_RULES <= ids
+    for r in all_rules():
+        assert r.description and r.hint and r.node_types
+
+
+def test_syntax_error_becomes_parse_finding():
+    findings = lint_source("def broken(:\n", path="x.py")
+    assert len(findings) == 1 and findings[0].rule == "parse-error"
+
+
+def test_findings_sorted_and_deduped():
+    findings = lint_source(BAD_RNG, path="x.py")
+    assert findings == sorted(findings, key=Finding.sort_key)
+    assert len({(f.rule, f.line, f.col, f.message) for f in findings}) \
+        == len(findings)
+
+
+def test_iter_python_files_deterministic_and_skips_pycache(tmp_path):
+    (tmp_path / "b.py").write_text("x = 1\n")
+    (tmp_path / "a.py").write_text("x = 1\n")
+    sub = tmp_path / "__pycache__"
+    sub.mkdir()
+    (sub / "c.py").write_text("x = 1\n")
+    (tmp_path / "notes.txt").write_text("not python\n")
+    got = iter_python_files([str(tmp_path)])
+    assert [os.path.basename(p) for p in got] == ["a.py", "b.py"]
+    assert got == iter_python_files([str(tmp_path), str(tmp_path / "a.py")])
+
+
+# --- reporters --------------------------------------------------------------
+
+def test_text_reporter_golden():
+    findings = lint_source(BAD_RNG, path="pkg/x.py")
+    assert len(findings) == 1
+    text = render_text(findings, files_checked=1)
+    lines = text.splitlines()
+    assert lines[0].startswith("pkg/x.py:6:27: rng-key-reuse: PRNG key "
+                               "'key' passed to a second consuming call")
+    assert "(fix: split the key" in lines[0]
+    assert lines[-1] == ("graftlint: 1 file(s), 1 finding(s) — 1 new, "
+                         "0 suppressed, 0 baselined")
+
+
+def test_text_reporter_hides_non_new_unless_verbose():
+    findings = lint_source(BAD_RNG, path="x.py")
+    findings[0].suppressed = True
+    quiet = render_text(findings, files_checked=1)
+    assert "rng-key-reuse" not in quiet.splitlines()[0] or \
+        len(quiet.splitlines()) == 1
+    loud = render_text(findings, files_checked=1, verbose=True)
+    assert "[suppressed]" in loud
+
+
+def test_json_reporter_golden():
+    findings = lint_source(BAD_RNG, path="x.py")
+    payload = json.loads(render_json(findings, files_checked=3))
+    assert payload["version"] == 1
+    assert payload["ok"] is False
+    assert payload["files_checked"] == 3
+    assert payload["counts"] == {"total": 1, "new": 1, "suppressed": 0,
+                                 "baselined": 0}
+    (f,) = payload["findings"]
+    assert f["rule"] == "rng-key-reuse" and f["line"] == 6
+    assert f["new"] is True and f["path"] == "x.py"
+
+
+# --- baseline ---------------------------------------------------------------
+
+def test_baseline_write_is_deterministic(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text(BAD_RNG)
+    findings = lint_paths([str(src)])
+    assert findings
+    look = line_text_lookup()
+    p1, p2 = tmp_path / "b1.json", tmp_path / "b2.json"
+    Baseline.write(str(p1), findings, look)
+    Baseline.write(str(p2), findings, look)
+    assert p1.read_bytes() == p2.read_bytes()
+    data = json.loads(p1.read_text())
+    assert data["entries"] and data["entries"][0]["path"] == "m.py"
+    assert not os.path.isabs(data["entries"][0]["path"])
+
+
+def test_baseline_survives_line_drift_but_not_line_edit(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text(BAD_RNG)
+    look = line_text_lookup()
+    bl = tmp_path / "baseline.json"
+    Baseline.write(str(bl), lint_paths([str(src)]), look)
+
+    # shift the finding down two lines: still baselined
+    src.write_text("# pad\n# pad\n" + BAD_RNG)
+    shifted = lint_paths([str(src)])
+    Baseline.load(str(bl)).apply(shifted, line_text_lookup())
+    assert all(f.baselined for f in shifted)
+
+    # edit the flagged line itself: resurfaces as new
+    edited = BAD_RNG.replace("jax.random.uniform(key, (2,))",
+                             "jax.random.uniform(key, (3,))")
+    src.write_text(edited)
+    fresh = lint_paths([str(src)])
+    Baseline.load(str(bl)).apply(fresh, line_text_lookup())
+    assert all(f.new for f in fresh)
+
+
+def test_baseline_entry_consumed_once(tmp_path):
+    # two identical violations on identical lines: one baseline entry
+    # absolves exactly one of them
+    double = BAD_RNG + "\n\n" + BAD_RNG.replace("def f", "def g")
+    src = tmp_path / "m.py"
+    src.write_text(double)
+    findings = lint_paths([str(src)])
+    assert len(findings) == 2
+    look = line_text_lookup()
+    bl = tmp_path / "baseline.json"
+    Baseline.write(str(bl), findings[:1], look)
+    fresh = lint_paths([str(src)])
+    Baseline.load(str(bl)).apply(fresh, line_text_lookup())
+    assert sum(f.baselined for f in fresh) == 1
+    assert sum(f.new for f in fresh) == 1
+
+
+# --- CLI --------------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_RNG)
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+
+    assert cli_main([str(clean), "--no-baseline"]) == 0
+    assert cli_main([str(bad), "--no-baseline"]) == 1
+    assert cli_main([]) == 2
+    assert cli_main(["--select", "not-a-rule", str(clean)]) == 2
+    # a typo'd path must NOT read as a green lint over zero files
+    assert cli_main([str(tmp_path / "no_such_dir")]) == 2
+    # a scoped --fix-baseline would silently drop other rules' entries
+    assert cli_main(["--fix-baseline", "--select", "rng-key-reuse",
+                     "--baseline", str(tmp_path / "b.json"),
+                     str(bad)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_fix_baseline_roundtrip(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_RNG)
+    bl = tmp_path / "baseline.json"
+    assert cli_main(["--fix-baseline", "--baseline", str(bl),
+                     str(bad)]) == 0
+    first = bl.read_bytes()
+    # baselined: the same tree now lints clean
+    assert cli_main(["--baseline", str(bl), str(bad)]) == 0
+    # deterministic: regenerating writes identical bytes
+    assert cli_main(["--fix-baseline", "--baseline", str(bl),
+                     str(bad)]) == 0
+    assert bl.read_bytes() == first
+    capsys.readouterr()
+
+
+def test_cli_json_format_and_select(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_RNG)
+    rc = cli_main(["--format", "json", "--no-baseline",
+                   "--select", "rng-key-reuse", str(bad)])
+    out = capsys.readouterr().out
+    payload = json.loads(out)
+    assert rc == 1 and payload["ok"] is False
+    assert {f["rule"] for f in payload["findings"]} == {"rng-key-reuse"}
+    rc = cli_main(["--format", "json", "--no-baseline",
+                   "--select", "hot-loop-sync", str(bad)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["findings"] == []
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in EXPECTED_RULES | {"telemetry-schema"}:
+        assert rule_id in out
+
+
+# --- telemetry artifact lint (the non-AST rule family) ----------------------
+
+def test_lint_run_dir_findings_and_cli(tmp_path, capsys):
+    from gansformer_tpu.analysis.telemetry_schema import lint_run_dir
+
+    # empty run dir: every artifact missing → findings, rule telemetry-schema
+    findings = lint_run_dir(str(tmp_path))
+    assert findings and all(f.rule == "telemetry-schema" for f in findings)
+    assert all(f.new for f in findings)
+
+    (tmp_path / "events.jsonl").write_text(
+        '{"name": "step", "ph": "X", "ts": 1, "dur": 2, '
+        '"pid": 0, "tid": 0}\n')
+    (tmp_path / "telemetry.prom").write_text(
+        "# TYPE data_wait_ms summary\ndata_wait_ms_count 3.0\n")
+    (tmp_path / "heartbeat-p0.json").write_text(json.dumps(
+        {"process": 0, "pid": 1, "host": "h", "time": 1.0,
+         "step": 0, "kimg": 0.0}))
+    assert lint_run_dir(str(tmp_path)) == []
+
+    rc = cli_main(["--run-dir", str(tmp_path)])
+    assert rc == 0
+    capsys.readouterr()
+
+    # a malformed event line carries file:line through to the Finding
+    (tmp_path / "events.jsonl").write_text('{"name": "x"}\n')
+    findings = lint_run_dir(str(tmp_path))
+    assert any(f.line == 1 and f.path.endswith("events.jsonl")
+               for f in findings)
