@@ -22,6 +22,13 @@ AST walk per file:
                      telemetry.prom / heartbeats) migrated from
                      ``scripts/check_telemetry.py``; not AST-based, but
                      it reports through the same ``Finding`` type.
+* ``learning_trend`` — the run-dir learning-evidence lint migrated from
+                     ``scripts/check_learning_trend.py`` (``--run-dir
+                     --learning-trend``); same ``Finding`` plumbing.
+* ``trace/``       — graftcheck (ISSUE 4): jaxpr-level semantic rules
+                     run against the repo's real jitted entry points —
+                     retrace hazards, const bloat, silent dtype
+                     promotion, sharding audit (``--trace``).
 
 Suppression syntax (same line as the finding)::
 

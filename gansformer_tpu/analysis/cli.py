@@ -4,9 +4,19 @@ Usage::
 
     gansformer-lint gansformer_tpu scripts            # lint the tree
     gansformer-lint --format json path/to/file.py     # machine output
+    gansformer-lint --trace gansformer_tpu scripts    # AST + jaxpr rules
+    gansformer-lint --trace --trace-profile full      # the whole matrix
     gansformer-lint --fix-baseline gansformer_tpu scripts
     gansformer-lint --list-rules
     gansformer-lint --run-dir results/00003-run       # artifact schema
+
+``--trace`` adds the jaxpr-level semantic rules (ISSUE 4,
+``analysis/trace/``): the repo's real jitted entry points are traced
+with abstract inputs and checked for retrace hazards, const bloat,
+silent dtype promotion, and sharding-vs-intent drift.  Trace findings
+ride the same suppression/baseline/exit-code machinery.  When jax has
+not been imported yet, the CLI forces a 2-CPU-device backend so the
+sharding audit has a mesh to resolve against.
 
 Exit codes: 0 — no new findings; 1 — new findings (or schema errors);
 2 — usage error.  "New" excludes inline-suppressed findings and entries
@@ -36,20 +46,43 @@ DEFAULT_BASELINE = os.path.join(
     "graftlint-baseline.json")
 
 
-def _select_rules(select: Optional[str], ignore: Optional[str]):
+def _select_rules(select: Optional[str], ignore: Optional[str],
+                  trace: bool = False):
+    """(ast_rules, trace_rules) honoring --select/--ignore across BOTH
+    registries; unknown ids are a usage error either way."""
     rules = engine.all_rules()
+    from gansformer_tpu.analysis.trace.base import all_trace_rules
+
+    trace_rules = all_trace_rules() if trace else []
+    ast_ids = {r.id for r in rules}
+    trace_ids = {r.id for r in all_trace_rules()}
+    known = ast_ids | trace_ids
     if select:
         wanted = {r.strip() for r in select.split(",") if r.strip()}
-        unknown = wanted - {r.id for r in rules}
+        unknown = wanted - known
         if unknown:
             raise SystemExit(
                 f"gansformer-lint: unknown rule(s): {sorted(unknown)} "
                 f"(see --list-rules)")
+        trace_only = wanted & (trace_ids - ast_ids)
+        if trace_only and not trace:
+            # a trace-only selection without --trace would walk every
+            # file with ZERO rules and report a false clean pass
+            raise SystemExit(
+                f"gansformer-lint: rule(s) {sorted(trace_only)} are "
+                f"trace rules — add --trace to run them")
         rules = [r for r in rules if r.id in wanted]
+        trace_rules = [r for r in trace_rules if r.id in wanted]
     if ignore:
         dropped = {r.strip() for r in ignore.split(",") if r.strip()}
+        unknown = dropped - known
+        if unknown:
+            raise SystemExit(
+                f"gansformer-lint: unknown rule(s): {sorted(unknown)} "
+                f"(see --list-rules)")
         rules = [r for r in rules if r.id not in dropped]
-    return rules
+        trace_rules = [r for r in trace_rules if r.id not in dropped]
+    return rules, trace_rules
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -77,28 +110,109 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--run-dir", default=None, metavar="DIR",
                    help="also schema-lint a run dir's telemetry artifacts "
                         "(events.jsonl/telemetry.prom/heartbeats)")
+    p.add_argument("--learning-trend", action="store_true",
+                   help="with --run-dir: also assert the run LEARNED "
+                        "(fitted metric drop + finite losses; the "
+                        "learning-trend rule — opt-in because smoke runs "
+                        "legitimately have no metric series)")
+    p.add_argument("--trace", action="store_true",
+                   help="also run the jaxpr-level trace rules against the "
+                        "repo's real jitted entry points (retrace hazards, "
+                        "const bloat, dtype promotion, sharding audit)")
+    p.add_argument("--trace-profile", choices=("structural", "fast", "full"),
+                   default="fast",
+                   help="trace cost/coverage: structural = tracing only "
+                        "(no compiles); fast = + retrace/sharding probes "
+                        "on the plain train steps; full = every rule on "
+                        "every matrix entry point")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="also print suppressed/baselined findings")
     return p
+
+
+def _force_virtual_devices() -> None:
+    """Give the process ≥2 CPU devices for the sharding audit — only
+    possible before jax initializes its backends; a no-op (with the
+    audit falling back to a skip-note) when jax is already live."""
+    import sys as _sys
+
+    if "jax" in _sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+
+def run_trace_findings(profile: str, trace_rules) -> List[Finding]:
+    """Trace-rule findings for the CLI/selfcheck path (device setup +
+    harness; see analysis/trace/harness.py for profile semantics)."""
+    _force_virtual_devices()
+    from gansformer_tpu.analysis.trace.harness import run_trace
+
+    findings, _ctx = run_trace(profile, rules=trace_rules)
+    return findings
+
+
+def run_selfcheck(run_dir: str, trace_profile: str = "fast") -> int:
+    """One-command AST + trace lint with a JSON artifact in the run dir
+    (``cli/train.py --selfcheck``).  Lints the installed package tree +
+    ``scripts/`` when present, applies the checked-in baseline, writes
+    ``<run_dir>/graftlint.json``, and returns the number of NEW
+    findings (0 = clean, training may proceed)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    paths = [os.path.join(pkg_root, "gansformer_tpu")]
+    scripts = os.path.join(pkg_root, "scripts")
+    if os.path.isdir(scripts):
+        paths.append(scripts)
+
+    rules, trace_rules = _select_rules(None, None, trace=True)
+    files = engine.iter_python_files(paths)
+    findings: List[Finding] = []
+    for path in files:
+        findings.extend(engine.lint_file(path, rules=rules))
+    findings.extend(run_trace_findings(trace_profile, trace_rules))
+    if os.path.exists(DEFAULT_BASELINE):
+        Baseline.load(DEFAULT_BASELINE).apply(findings, line_text_lookup())
+
+    artifact = os.path.join(run_dir, "graftlint.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        f.write(reporters.render_json(findings, len(files)))
+        f.write("\n")
+    return sum(1 for f in findings if f.new)
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
+        from gansformer_tpu.analysis.trace.base import all_trace_rules
+
         for cls in engine.all_rules():
             print(f"{cls.id:<26s} {cls.description}")
+        for cls in all_trace_rules():
+            print(f"{cls.id:<26s} [trace] {cls.description}")
         print(f"{'telemetry-schema':<26s} run-dir artifact schema "
               f"(--run-dir; scripts/check_telemetry.py shim)")
+        print(f"{'learning-trend':<26s} run-dir learning evidence "
+              f"(--run-dir --learning-trend; "
+              f"scripts/check_learning_trend.py shim)")
         return 0
 
-    if not args.paths and not args.run_dir:
+    if not args.paths and not args.run_dir and not args.trace:
         build_parser().print_usage(sys.stderr)
         print("gansformer-lint: no paths given", file=sys.stderr)
         return 2
+    if args.learning_trend and not args.run_dir:
+        print("gansformer-lint: --learning-trend needs --run-dir",
+              file=sys.stderr)
+        return 2
 
     try:
-        rules = _select_rules(args.select, args.ignore)
+        rules, trace_rules = _select_rules(args.select, args.ignore,
+                                           trace=args.trace)
     except SystemExit as e:
         print(e, file=sys.stderr)
         return 2
@@ -122,6 +236,11 @@ def main(argv=None) -> int:
     for path in files:
         findings.extend(engine.lint_file(path, rules=rules))
 
+    if args.trace and trace_rules:
+        # trace findings join BEFORE baseline application so they can be
+        # baselined/suppressed exactly like AST findings
+        findings.extend(run_trace_findings(args.trace_profile, trace_rules))
+
     line_text = line_text_lookup()
 
     baseline_path = args.baseline or (
@@ -142,6 +261,11 @@ def main(argv=None) -> int:
         from gansformer_tpu.analysis.telemetry_schema import lint_run_dir
 
         findings.extend(lint_run_dir(args.run_dir))
+        if args.learning_trend:
+            from gansformer_tpu.analysis.learning_trend import (
+                lint_learning_trend)
+
+            findings.extend(lint_learning_trend(args.run_dir))
 
     if args.format == "json":
         print(reporters.render_json(findings, len(files)))

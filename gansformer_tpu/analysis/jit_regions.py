@@ -8,7 +8,10 @@ definitions execute under a JAX trace* (``jax.jit`` / ``pjit`` /
   ``@shard_map(…)``;
 * call wrap — ``f2 = jax.jit(f)`` or any ``jax.jit(f, …)`` appearing as
   an expression (e.g. field values in a dataclass constructor);
-* partial — ``jax.jit(functools.partial(f, flag=True), …)``.
+* partial — ``jax.jit(functools.partial(f, flag=True), …)``;
+* lambda wrap — ``step = jax.jit(lambda s, b: _step(s, b))``: the lambda
+  body runs under the trace, so every function it references by name is
+  seeded into the region (the lambda itself has no def to mark).
 
 Membership then propagates transitively: a function *referenced by
 name* from an in-region function is in the region too — plain calls,
@@ -152,9 +155,18 @@ class JitIndex:
                         break
             elif isinstance(node, ast.Call) and is_jit_wrapper(node.func):
                 if node.args:
+                    resolved = False
                     name = self._wrapped_def(node.args[0])
                     if name:
                         seeds.extend(self._defs_by_name.get(name, ()))
+                        resolved = True
+                    elif isinstance(node.args[0], ast.Lambda):
+                        # jax.jit(lambda s, b: _step(s, b)) — the lambda
+                        # body is the region; seed what it references
+                        for ref in self._lambda_refs(node.args[0]):
+                            seeds.extend(self._defs_by_name.get(ref, ()))
+                        resolved = True
+                    if resolved:
                         pos = self._jit_call_donations(node)
                         if pos:
                             # the jit result donates; record under the
@@ -162,6 +174,18 @@ class JitIndex:
                             for tgt in self._assign_targets_of(node):
                                 self.donating[tgt] = pos
         return seeds
+
+    @staticmethod
+    def _lambda_refs(lam: ast.Lambda) -> Set[str]:
+        """Bare names the lambda body loads, minus its own parameters."""
+        params = {a.arg for a in (lam.args.args + lam.args.kwonlyargs
+                                  + lam.args.posonlyargs)}
+        for extra in (lam.args.vararg, lam.args.kwarg):
+            if extra is not None:
+                params.add(extra.arg)
+        return {n.id for n in ast.walk(lam.body)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                and n.id not in params}
 
     def _decorator_is_jit(self, dec: ast.AST) -> bool:
         if is_jit_wrapper(dec):

@@ -1,0 +1,139 @@
+"""Run-dir learning-evidence lint (migrated from
+scripts/check_learning_trend.py — the last ad-hoc checker outside
+``analysis/``; the script remains as a shim).
+
+The reference's verification model is golden-metric empiricism: train,
+then watch FID fall (SURVEY.md §4 item 1).  This rule makes that an
+assertable artifact property: given a run dir, it reads the recorded
+``metric-*.txt`` series (written by the tick loop / evaluate CLI) and
+``stats.jsonl``, and asserts
+
+  * >= ``min_points`` metric evaluations exist,
+  * the metric IMPROVED: fitted last < fitted first by >= ``min_drop``
+    (relative), using a least-squares line over the series so a noisy
+    final tick cannot fake or hide a trend,
+  * losses in stats.jsonl stayed finite throughout.
+
+``check`` keeps the pre-framework result-dict contract (the script shim
+and tests/test_learning_trend.py call it directly);
+``lint_learning_trend`` adapts the same failures into ``Finding``
+objects (rule id ``learning-trend``) for
+``gansformer-lint --run-dir <dir> --learning-trend``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+from typing import List, Optional, Tuple
+
+from gansformer_tpu.analysis.findings import Finding
+
+
+def read_metric_series(run_dir: str, metric: Optional[str]):
+    """[(kimg, value)] from metric-<name>.txt (tick-loop format:
+    'kimg <k> <name> <v>').  metric=None picks the first fid* file."""
+    if metric:
+        paths = [os.path.join(run_dir, f"metric-{metric}.txt")]
+    else:
+        paths = sorted(glob.glob(os.path.join(run_dir, "metric-fid*.txt")))
+    if not paths or not os.path.exists(paths[0]):
+        return None, []
+    name = os.path.basename(paths[0])[len("metric-"):-len(".txt")]
+    series = []
+    with open(paths[0]) as f:
+        for line in f:
+            m = re.match(r"kimg\s+([\d.]+)\s+\S+\s+([\d.eE+-]+)", line)
+            if m:
+                series.append((float(m.group(1)), float(m.group(2))))
+    return name, series
+
+
+def fit_line(series) -> Tuple[float, float]:
+    """Least-squares (intercept, slope) over (kimg, value)."""
+    n = len(series)
+    xs = [k for k, _ in series]
+    ys = [v for _, v in series]
+    mx, my = sum(xs) / n, sum(ys) / n
+    var = sum((x - mx) ** 2 for x in xs) or 1e-12
+    slope = sum((x - mx) * (y - my) for x, y in series) / var
+    return my - slope * mx, slope
+
+
+def check(run_dir: str, metric: Optional[str], min_points: int,
+          min_drop: float) -> dict:
+    """{ok, metric, first, last, fit_drop_rel, points[, error]} — the
+    legacy contract."""
+    name, series = read_metric_series(run_dir, metric)
+    out = {"ok": False, "run_dir": run_dir, "metric": name,
+           "points": len(series)}
+    if len(series) < min_points:
+        out["error"] = (f"only {len(series)} metric points "
+                        f"(need >= {min_points})")
+        return out
+    b, a = fit_line(series)
+    first_fit = b + a * series[0][0]
+    last_fit = b + a * series[-1][0]
+    drop = (first_fit - last_fit) / abs(first_fit) if first_fit else 0.0
+    out.update({
+        "first": round(series[0][1], 4), "last": round(series[-1][1], 4),
+        "first_fit": round(first_fit, 4), "last_fit": round(last_fit, 4),
+        "fit_drop_rel": round(drop, 4), "slope_per_kimg": round(a, 6),
+    })
+    if drop < min_drop:
+        out["error"] = (f"fitted {name} fell only {drop * 100:.1f}% "
+                        f"(need >= {min_drop * 100:.0f}%) — no learning "
+                        f"evidence")
+        return out
+    stats_path = os.path.join(run_dir, "stats.jsonl")
+    if os.path.exists(stats_path):
+        for line in open(stats_path):
+            row = json.loads(line)
+            for k, v in row.items():
+                if k.startswith("Loss/") and isinstance(v, float) \
+                        and not math.isfinite(v):
+                    out["error"] = f"non-finite {k} at tick " \
+                                   f"{row.get('Progress/tick')}"
+                    return out
+    out["ok"] = True
+    return out
+
+
+def lint_learning_trend(run_dir: str, metric: Optional[str] = None,
+                        min_points: int = 3,
+                        min_drop: float = 0.10) -> List[Finding]:
+    """``check``'s verdict as Findings (rule id ``learning-trend``) for
+    the shared reporters/CLI.  One finding per failed run dir."""
+    result = check(run_dir, metric, min_points, min_drop)
+    if result["ok"]:
+        return []
+    return [Finding(
+        rule="learning-trend", path=run_dir, line=0, col=0,
+        message=result.get("error", "no learning evidence"),
+        hint="train longer / fix the regression, or point --run-dir at "
+             "a run that recorded a metric series")]
+
+
+def main(argv=None) -> int:
+    """Legacy CLI contract: one JSON line {ok, ...}; exit 0 iff ok."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Assert a run dir shows learning evidence")
+    p.add_argument("run_dir")
+    p.add_argument("--metric", default=None,
+                   help="metric name (default: first metric-fid*.txt)")
+    p.add_argument("--min-points", type=int, default=3)
+    p.add_argument("--min-drop", type=float, default=0.10,
+                   help="required relative drop of the fitted line")
+    args = p.parse_args(argv)
+    out = check(args.run_dir, args.metric, args.min_points, args.min_drop)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

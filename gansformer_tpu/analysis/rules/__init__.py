@@ -9,12 +9,15 @@ One module per rule (see docs/static-analysis.md for the catalog):
                         scripts/check_hot_loop.py, which is now a shim)
 * ``thread_state``    — thread-shared-state
 * ``telemetry_names`` — telemetry-name-convention
+* ``retrace_static``  — retrace-static (the AST companion of the
+                        jaxpr-level retrace-hazard trace rule, ISSUE 4)
 """
 
 from gansformer_tpu.analysis.rules import (  # noqa: F401
     donation,
     host_sync,
     hot_loop,
+    retrace_static,
     rng_reuse,
     telemetry_names,
     thread_state,

@@ -1,0 +1,184 @@
+"""retrace-static — cache-key instability visible without tracing.
+
+The AST companion of the dynamic ``retrace-hazard`` trace rule (ISSUE
+4): the dynamic probe can only exercise entry points the harness knows
+how to call; this rule catches the same bug family anywhere in the
+tree, in two shapes:
+
+* **unhashable static argument** — a call passes a list/dict/set
+  display at a position (or keyword) the target's ``jax.jit(...,
+  static_argnums=…/static_argnames=…)`` declared static.  jit hashes
+  static arguments to build the cache key: an unhashable value raises
+  at best; a freshly-built hashable-but-unstable one recompiles per
+  call.
+* **trace-baked mutable** — a function inside a jit region reads a
+  module-level mutable (list/dict/set) that the module also *mutates*.
+  The value is frozen into the jaxpr at trace time; later mutations are
+  silently ignored — the "I updated the config dict but the step didn't
+  change" bug.  Never-mutated module dicts (lookup tables) are de-facto
+  constants and stay quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+from gansformer_tpu.analysis.jit_regions import is_jit_wrapper
+
+_MUTABLE_DISPLAYS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTATOR_METHODS = {"append", "extend", "insert", "add", "update", "pop",
+                    "popitem", "remove", "discard", "clear", "setdefault"}
+
+
+def _static_decl(call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    """(static positions, static names) declared on one jit(...) call."""
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames"):
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant):
+                    if isinstance(v.value, int) and kw.arg == "static_argnums":
+                        nums.append(v.value)
+                    elif isinstance(v.value, str):
+                        names.append(v.value)
+    return tuple(nums), tuple(names)
+
+
+@register
+class RetraceStaticRule(Rule):
+    id = "retrace-static"
+    description = ("static-arg / closure cache-key instability: "
+                   "unhashable value at a static_argnums position, or a "
+                   "jit-region read of a mutated module-level mutable")
+    hint = ("pass static args as hashable scalars/tuples; pass mutated "
+            "state as explicit jit arguments instead of closing over it")
+    node_types = (ast.Module,)
+
+    def check(self, node: ast.Module, ctx: FileContext) -> None:
+        self._check_static_args(node, ctx)
+        self._check_baked_mutables(node, ctx)
+
+    # -- unhashable static arguments -----------------------------------------
+
+    def _check_static_args(self, module: ast.Module,
+                           ctx: FileContext) -> None:
+        # name -> (static positions, static names) for jitted callables
+        declared: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {}
+        for node in ast.walk(module):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and (
+                            is_jit_wrapper(dec.func)
+                            or (dec.args
+                                and is_jit_wrapper(dec.args[0]))):
+                        nums, names = _static_decl(dec)
+                        if nums or names:
+                            declared[node.name] = (nums, names)
+            elif isinstance(node, ast.Call) and is_jit_wrapper(node.func):
+                nums, names = _static_decl(node)
+                if not (nums or names):
+                    continue
+                parent = ctx.parent(node)
+                if isinstance(parent, ast.Assign):
+                    for t in parent.targets:
+                        if isinstance(t, ast.Name):
+                            declared[t.id] = (nums, names)
+        if not declared:
+            return
+        for node in ast.walk(module):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in declared):
+                continue
+            nums, names = declared[node.func.id]
+            for i in nums:
+                if i < len(node.args) and isinstance(
+                        node.args[i], _MUTABLE_DISPLAYS):
+                    ctx.report(self, node.args[i],
+                               f"unhashable static argument at position "
+                               f"{i} of jitted {node.func.id!r} — jit "
+                               f"cannot key its cache on a "
+                               f"list/dict/set")
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(
+                        kw.value, _MUTABLE_DISPLAYS):
+                    ctx.report(self, kw.value,
+                               f"unhashable static argument "
+                               f"{kw.arg!r} of jitted "
+                               f"{node.func.id!r} — jit cannot key its "
+                               f"cache on a list/dict/set")
+
+    # -- trace-baked mutated module-level mutables ---------------------------
+
+    def _module_mutables(self, module: ast.Module) -> Set[str]:
+        """Module-level names bound to mutable displays."""
+        out: Set[str] = set()
+        for stmt in module.body:
+            if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, _MUTABLE_DISPLAYS):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _mutated_names(self, module: ast.Module,
+                       candidates: Set[str]) -> Set[str]:
+        """The subset of ``candidates`` the module mutates anywhere:
+        mutator method calls, subscript stores/deletes, aug-assigns."""
+        mutated: Set[str] = set()
+        for node in ast.walk(module):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATOR_METHODS and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in candidates:
+                mutated.add(node.func.value.id)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in candidates:
+                mutated.add(node.value.id)
+            elif isinstance(node, ast.AugAssign):
+                tgt = node.target
+                if isinstance(tgt, ast.Name) and tgt.id in candidates:
+                    mutated.add(tgt.id)
+                elif isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id in candidates:
+                    mutated.add(tgt.value.id)
+        return mutated
+
+    def _check_baked_mutables(self, module: ast.Module,
+                              ctx: FileContext) -> None:
+        mutables = self._module_mutables(module)
+        if not mutables:
+            return
+        mutated = self._mutated_names(module, mutables)
+        if not mutated:
+            return
+        for node in ast.walk(module):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not ctx.jit.is_jit(node):
+                continue
+            # param names shadow module globals
+            args = node.args
+            shadowed = {a.arg for a in (args.args + args.kwonlyargs
+                                        + args.posonlyargs)}
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load) and \
+                        sub.id in mutated and sub.id not in shadowed:
+                    ctx.report(self, sub,
+                               f"jit-traced {node.name!r} reads module-"
+                               f"level mutable {sub.id!r} (mutated "
+                               f"elsewhere in this module) — the value "
+                               f"is baked in at trace time; mutations "
+                               f"never reach the compiled program")

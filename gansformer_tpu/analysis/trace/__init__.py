@@ -1,0 +1,33 @@
+"""graftcheck — jaxpr-level semantic analysis (ISSUE 4).
+
+The AST half of graftlint (``analysis/engine.py`` + ``rules/``) reads
+source text; this package reads *traced programs*.  It imports the
+repo's real jitted entry points (``train/steps.py``), traces them with
+abstract inputs over a tiny config matrix, and runs rules against what
+XLA will actually see — the bug classes that burn TPU hours without
+ever looking wrong in source:
+
+* ``retrace``        — retrace-hazard: equivalent-but-differently-
+                       constructed inputs must not trigger a second
+                       compile (weak types, static kwargs, closures).
+* ``const_bloat``    — jaxpr-const-bloat: big arrays closed over
+                       instead of passed, baked into every executable.
+* ``dtype_flow``     — dtype-promotion: silent bf16→f32 / →f64 upcasts
+                       inserted by type promotion.
+* ``sharding_audit`` — sharding-audit: oversize fully-replicated
+                       params and donation-defeating output shardings,
+                       resolved on a fake 2-device mesh.
+
+Findings feed the SAME engine stack as the AST rules — ``Finding``
+objects, inline ``# graftlint: disable=`` suppressions (anchored on
+real source lines), the checked-in baseline, text/JSON reporters, and
+the ``gansformer-lint --trace`` CLI exit-code contract.
+
+See docs/static-analysis.md ("Trace rules") for the catalog and the
+"why AST lint can't see this" discussion.
+"""
+
+from gansformer_tpu.analysis.trace.base import (  # noqa: F401
+    EntryPoint, TraceContext, TraceRule, all_trace_rules, register)
+from gansformer_tpu.analysis.trace.harness import (  # noqa: F401
+    PROFILES, run_trace)

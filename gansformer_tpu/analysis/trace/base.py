@@ -1,0 +1,280 @@
+"""Trace-rule registry + shared context — the jaxpr-level half of graftlint.
+
+The AST engine (``analysis/engine.py``) sees source text; this layer sees
+*meaning*: it imports the repo's real jitted entry points, traces them
+with abstract inputs (``jax.make_jaxpr`` / ``jax.eval_shape``), and lets
+rules walk the resulting jaxprs, compilation caches, and resolved
+shardings.  Findings flow into the exact same ``Finding`` /
+baseline / reporter / CLI stack, so trace findings gate, suppress, and
+baseline like AST findings do.
+
+Anchoring: every trace finding points at a *source* location — the
+jaxpr equation's user frame when one exists (dtype promotions land on
+the line that promoted), else the traced function's ``def`` line.  An
+inline ``# graftlint: disable=<rule>`` on that line suppresses the
+finding, same syntax as the AST side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from gansformer_tpu.analysis.engine import _parse_suppressions
+from gansformer_tpu.analysis.findings import Finding
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    """One traceable jitted entry point plus everything rules need.
+
+    ``abstract_args`` drive the structural rules (``make_jaxpr`` — no
+    compile, no execution); ``make_args`` builds *fresh concrete* inputs
+    for the dynamic rules (retrace probing calls the function for real).
+    ``train_step`` marks the hot-loop steps — the fast profile's dynamic
+    rules run on those only, the full profile on everything.
+    """
+
+    name: str                        # e.g. "steps.d_step[tiny-f32]"
+    fn: Callable                     # the jitted callable
+    abstract_args: Tuple[Any, ...]   # ShapeDtypeStructs / None leaves
+    path: str                        # source file of the traced fn
+    line: int                        # its ``def`` line (finding anchor)
+    config_name: str = ""
+    static_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    make_args: Optional[Callable[[], Tuple[Any, ...]]] = None
+    donate_argnums: Tuple[int, ...] = ()
+    train_step: bool = False
+    # per-positional-arg placement tags for the sharding audit:
+    # "state" | "batch" | "stack" | "repl" (see trace/sharding_audit.py)
+    arg_specs: Tuple[str, ...] = ()
+    # model compute dtype for this config ("float32" | "bfloat16") — the
+    # dtype rule only hunts bf16→f32 upcasts when the model runs bf16.
+    compute_dtype: str = "float32"
+
+    @property
+    def anchor(self) -> Tuple[str, int]:
+        return (self.path, self.line)
+
+
+class TraceRule:
+    """Base class for jaxpr-level rules.
+
+    ``dynamic`` rules execute or compile the entry point (retrace
+    probing, sharding resolution) and are therefore orders of magnitude
+    more expensive than the structural rules, which only trace.
+    """
+
+    id: str = ""
+    description: str = ""
+    hint: str = ""
+    dynamic: bool = False
+
+    def check(self, ep: EntryPoint, ctx: "TraceContext") -> None:
+        raise NotImplementedError
+
+
+_TRACE_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    if not cls.id:
+        raise ValueError(f"trace rule {cls.__name__} has no id")
+    if _TRACE_REGISTRY.get(cls.id, cls) is not cls:
+        raise ValueError(f"duplicate trace rule id {cls.id!r}")
+    _TRACE_REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_trace_rules() -> List[type]:
+    """Every registered trace rule class (imports the bundled set)."""
+    from gansformer_tpu.analysis.trace import (  # noqa: F401  (registers)
+        const_bloat, dtype_flow, retrace, sharding_audit)
+
+    return [_TRACE_REGISTRY[k] for k in sorted(_TRACE_REGISTRY)]
+
+
+class TraceContext:
+    """Shared per-run state: jaxpr cache, suppressions, findings."""
+
+    def __init__(self):
+        self.findings: List[Finding] = []
+        self._jaxprs: Dict[str, Any] = {}       # entry name -> ClosedJaxpr
+        self._suppress_cache: Dict[str, tuple] = {}
+        self._seen: set = set()
+        self.notes: List[str] = []              # non-finding diagnostics
+
+    # -- tracing -------------------------------------------------------------
+
+    def jaxpr(self, ep: EntryPoint):
+        """``jax.make_jaxpr`` of the entry point over its abstract args —
+        traced once, shared by every structural rule."""
+        if ep.name not in self._jaxprs:
+            import jax
+
+            fn = ep.fn
+            if ep.static_kwargs:
+                import functools
+
+                fn = functools.partial(fn, **ep.static_kwargs)
+            self._jaxprs[ep.name] = jax.make_jaxpr(fn)(*ep.abstract_args)
+        return self._jaxprs[ep.name]
+
+    # -- suppression (same inline syntax as the AST engine) ------------------
+
+    def _suppressions(self, path: str):
+        if path not in self._suppress_cache:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                lines = []
+            self._suppress_cache[path] = _parse_suppressions(lines)
+        return self._suppress_cache[path]
+
+    def is_suppressed(self, rule_id: str, path: str, line: int) -> bool:
+        per_line, whole_file = self._suppressions(path)
+        on_line = per_line.get(line, ())
+        return (rule_id in on_line or "all" in on_line
+                or rule_id in whole_file or "all" in whole_file)
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self, rule: TraceRule, where: Tuple[str, int], message: str,
+               hint: Optional[str] = None) -> Optional[Finding]:
+        """File a finding anchored at ``(path, line)``.  The Finding
+        carries the ABSOLUTE path: downstream consumers (the CLI's
+        line_text_lookup, Baseline key computation) resolve finding
+        paths against the CWD, which for trace findings is unrelated to
+        the anchor — an absolute path keeps baseline matching and
+        suppression working from any working directory (Baseline
+        relativizes against its own root when writing keys)."""
+        path, line = where
+        abspath = path if os.path.isabs(path) else \
+            os.path.join(_REPO_ROOT, path)
+        key = (rule.id, abspath, line, message)
+        if key in self._seen:
+            return None
+        self._seen.add(key)
+        f = Finding(rule=rule.id, path=abspath, line=line, col=0,
+                    message=message,
+                    hint=rule.hint if hint is None else hint,
+                    suppressed=self.is_suppressed(rule.id, abspath, line))
+        self.findings.append(f)
+        return f
+
+
+# -- jaxpr walking utilities (shared by the structural rules) ----------------
+
+def sub_jaxprs(value) -> List[Any]:
+    """The Jaxpr objects nested inside one eqn-param value (pjit/scan/
+    cond bodies arrive as ClosedJaxpr or Jaxpr, sometimes in lists)."""
+    import jax.core as jcore
+
+    if isinstance(value, jcore.ClosedJaxpr):
+        return [value.jaxpr]
+    if isinstance(value, jcore.Jaxpr):
+        return [value]
+    if isinstance(value, (list, tuple)):
+        out: List[Any] = []
+        for v in value:
+            out.extend(sub_jaxprs(v))
+        return out
+    return []
+
+
+def iter_eqns(jaxpr) -> Iterable[Any]:
+    """Every eqn in a jaxpr, recursing into pjit/scan/cond sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def iter_consts(closed) -> Iterable[Any]:
+    """Every constant closed over anywhere in the program: the top-level
+    ``ClosedJaxpr.consts`` plus the consts of every nested ClosedJaxpr
+    (a jitted function's closure constants live on the inner pjit
+    jaxpr, not the outer one)."""
+    import jax.core as jcore
+
+    yield from closed.consts
+    for eqn in iter_eqns(closed.jaxpr):
+        for v in eqn.params.values():
+            for item in (v if isinstance(v, (list, tuple)) else [v]):
+                if isinstance(item, jcore.ClosedJaxpr):
+                    yield from item.consts
+
+
+def eqn_frame(eqn) -> Optional[Tuple[str, int]]:
+    """(file, line) of the user frame that generated this eqn, or None
+    (library-internal eqns carry no user frame)."""
+    try:
+        import jax._src.source_info_util as siu
+
+        frame = siu.user_frame(eqn.source_info)
+        if frame is not None:
+            return (frame.file_name, frame.start_line)
+    except Exception:
+        pass
+    return None
+
+
+def in_repo(path: Optional[str]) -> bool:
+    if not path:
+        return False
+    try:
+        return os.path.abspath(path).startswith(_REPO_ROOT + os.sep)
+    except ValueError:
+        return False
+
+
+def line_text(path: str, line: int) -> str:
+    abspath = path if os.path.isabs(path) else os.path.join(_REPO_ROOT, path)
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        return lines[line - 1] if 0 < line <= len(lines) else ""
+    except OSError:
+        return ""
+
+
+def def_site(fn: Callable) -> Tuple[str, int]:
+    """(path, def line) of the *user* function under a jit wrapper —
+    falls back to the wrapper itself, then to a placeholder."""
+    import functools
+    import inspect
+
+    probe = fn
+    for _ in range(8):
+        if isinstance(probe, functools.partial):
+            probe = probe.func
+            continue
+        wrapped = getattr(probe, "__wrapped__", None)
+        if wrapped is None:
+            break
+        probe = wrapped
+    try:
+        path = inspect.getsourcefile(probe) or "<unknown>"
+        _, line = inspect.getsourcelines(probe)
+        return (path, line)
+    except (OSError, TypeError):
+        return ("<unknown>", 0)
+
+
+def sizeof(const) -> int:
+    """Best-effort byte size of a jaxpr constant."""
+    nbytes = getattr(const, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    try:
+        import numpy as np
+
+        return int(np.asarray(const).nbytes)
+    except Exception:
+        return 0

@@ -1,0 +1,58 @@
+"""jaxpr-const-bloat — large constants baked into the compiled program.
+
+An array closed over by a jitted function (instead of passed as an
+argument) becomes a jaxpr *constant*: it is embedded in every
+executable specialization, re-uploaded per compile, and duplicated in
+HBM — invisible in the source, obvious in the jaxpr.  The classic form
+is an ``np.ndarray`` captured by a closure (filter banks, positional
+grids, precomputed tables).
+
+Threshold: constants are everywhere (scalar literals, tiny index
+vectors) and harmless below a few KiB; the rule flags only constants
+whose byte size crosses ``THRESHOLD_BYTES`` — at the tiny trace config
+that means anything big enough there will be *proportionally* enormous
+at the flagship resolution.
+"""
+
+from __future__ import annotations
+
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, TraceRule, iter_consts, register, sizeof)
+
+THRESHOLD_BYTES = 64 * 1024
+
+
+@register
+class ConstBloatRule(TraceRule):
+    id = "jaxpr-const-bloat"
+    description = ("closed-over array baked into the jaxpr as a large "
+                   "constant (duplicated per executable, re-uploaded per "
+                   "compile)")
+    hint = ("pass the array as a function argument (donate or shard it "
+            "like any other input) instead of closing over it")
+
+    threshold = THRESHOLD_BYTES
+
+    def __init__(self):
+        # spans all entry points of one run: the same def traced under
+        # two matrix configs anchors at the same line — report each
+        # (function, const) once so the baseline entry count doesn't
+        # depend on the profile's config coverage
+        self._seen = set()
+
+    def check(self, ep: EntryPoint, ctx: TraceContext) -> None:
+        closed = ctx.jaxpr(ep)
+        entry = ep.name.split("[")[0]        # config-independent identity
+        for const in iter_consts(closed):
+            n = sizeof(const)
+            if n < self.threshold:
+                continue
+            shape = getattr(const, "shape", ())
+            dtype = getattr(const, "dtype", type(const).__name__)
+            key = (entry, ep.anchor, tuple(shape), str(dtype))
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            ctx.report(self, ep.anchor,
+                       f"{ep.name}: jaxpr constant {tuple(shape)} {dtype} "
+                       f"({n / 1024:.0f} KiB) baked into the program")

@@ -1,0 +1,85 @@
+"""dtype-promotion — silent float upcasts visible only in the jaxpr.
+
+NumPy-style type promotion inserts ``convert_element_type`` equations
+the source never wrote: a bf16 activation meeting an f32 literal
+silently computes the rest of the expression in f32 (twice the HBM
+traffic and matmul cost the bf16 config was chosen to avoid), and any
+f64 appearing under an accidentally-enabled ``jax_enable_x64`` poisons
+everything downstream.
+
+The rule walks every ``convert_element_type`` in the traced program and
+flags *silent* float upcasts: the source line that produced the convert
+(via the eqn's user frame) does not itself spell a dtype or an
+``astype`` — if the cast is written out (``x.astype(jnp.float32)`` for
+loss accumulation, an f32 head layer) it is a decision, not a leak.
+Findings anchor on the promoting source line, so the usual inline
+``# graftlint: disable=dtype-promotion`` suppresses intentional cases
+the heuristic cannot see.
+"""
+
+from __future__ import annotations
+
+import re
+
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, TraceRule, eqn_frame, in_repo, iter_eqns,
+    line_text, register)
+
+_FLOAT_BITS = {"bfloat16": 16, "float16": 16, "float32": 32, "float64": 64}
+
+# A source line that spells any of these made its cast on purpose.
+_EXPLICIT = re.compile(
+    r"astype|convert_element_type|float32|float64|float16|bfloat16"
+    r"|\.dtype|dtype=")
+
+
+def _bits(dtype) -> int:
+    return _FLOAT_BITS.get(getattr(dtype, "name", str(dtype)), 0)
+
+
+@register
+class DtypePromotionRule(TraceRule):
+    id = "dtype-promotion"
+    description = ("silent float upcast (bf16→f32 / →f64) inserted by "
+                   "type promotion, not written in the source")
+    hint = ("make the cast explicit (x.astype(...)) if intended, or fix "
+            "the stray wide-dtype operand (jnp.float32 literals, default-"
+            "dtype jnp.arange/linspace) if not")
+
+    def __init__(self):
+        # spans ALL entry points of one run: a single promoting line in
+        # shared model code is traced via many entries — one finding per
+        # line keeps `--fix-baseline` output independent of how many
+        # entries (fast vs full profile) happened to reach the line
+        self._seen = set()
+
+    def check(self, ep: EntryPoint, ctx: TraceContext) -> None:
+        closed = ctx.jaxpr(ep)
+        seen = self._seen
+        for eqn in iter_eqns(closed.jaxpr):
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            in_aval = eqn.invars[0].aval
+            out_aval = eqn.outvars[0].aval
+            ib = _bits(getattr(in_aval, "dtype", None))
+            ob = _bits(getattr(out_aval, "dtype", None))
+            if not ib or ob <= ib:
+                continue    # not a float→wider-float conversion
+            if ob < 64 and ep.compute_dtype != "bfloat16":
+                # in an all-f32 model, f32 converts are not a regression;
+                # only ever-wider f64 is. bf16 models audit bf16→f32 too.
+                continue
+            frame = eqn_frame(eqn)
+            if frame is None or not in_repo(frame[0]):
+                continue    # library-internal promotion; not actionable
+            text = line_text(*frame)
+            if _EXPLICIT.search(text):
+                continue    # cast is written in the source — a decision
+            key = (frame[0], frame[1], str(in_aval.dtype),
+                   str(out_aval.dtype))
+            if key in seen:
+                continue
+            seen.add(key)
+            ctx.report(self, frame,
+                       f"silent {in_aval.dtype}→{out_aval.dtype} promotion "
+                       f"(first traced via {ep.name})")

@@ -1,0 +1,203 @@
+"""The traced entry-point catalog: the repo's REAL jitted functions.
+
+This module is the contract between the trace rules and the training
+stack: it builds ``make_train_steps`` for a small config matrix and
+describes each jitted function — abstract input shapes for structural
+tracing, fresh-concrete-input builders for the dynamic rules, donation
+positions, and the source anchor findings point at.
+
+The matrix is deliberately tiny (resolution 16, k=2, batch 2): jaxpr
+STRUCTURE — dtype flow, closed-over constants, sharding decisions,
+cache keying — is shape-independent for this model family, so the tiny
+trace stands in for the flagship config at a fraction of the cost.
+
+* ``tiny-f32``  — default float32 model; the retrace / const / sharding
+                  reference member.  Its interval choice (d_reg == g_reg
+                  == 2) makes ``make_train_steps`` build the fused
+                  ``cycle`` program too, so the flagship dispatch mode
+                  is traced without a third config.
+* ``tiny-bf16`` — bfloat16 compute path; the dtype-promotion member
+                  (bf16→f32 upcasts only exist here).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from gansformer_tpu.analysis.trace.base import EntryPoint, def_site
+from gansformer_tpu.core.config import (
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, TrainConfig)
+
+_BATCH = 2
+_RES = 16
+
+
+def tiny_config(dtype: str = "float32", fused: bool = False,
+                attention: str = "simplex") -> ExperimentConfig:
+    return ExperimentConfig(
+        name=f"trace-tiny-{dtype}{'-fused' if fused else ''}",
+        model=ModelConfig(resolution=_RES, components=2, latent_dim=16,
+                          w_dim=16, mapping_dim=16, mapping_layers=2,
+                          fmap_base=64, fmap_max=32, attention=attention,
+                          attn_start_res=8, attn_max_res=8,
+                          mbstd_group_size=2, dtype=dtype),
+        train=TrainConfig(batch_size=_BATCH, total_kimg=1, d_reg_interval=2,
+                          g_reg_interval=2, pl_batch_shrink=2, ema_kimg=0.01,
+                          style_mixing_prob=0.5, fused_cycle=fused),
+        data=DataConfig(resolution=_RES, source="synthetic"),
+        mesh=MeshConfig())
+
+
+def trace_configs() -> Dict[str, ExperimentConfig]:
+    return {
+        "tiny-f32": tiny_config("float32"),
+        "tiny-bf16": tiny_config("bfloat16"),
+    }
+
+
+def _abstract_state(cfg: ExperimentConfig):
+    import jax
+
+    from gansformer_tpu.train.state import create_train_state
+
+    return jax.eval_shape(lambda k: create_train_state(cfg, k),
+                          jax.random.PRNGKey(0))
+
+
+class _StateFactory:
+    """Fresh, independently-constructed concrete TrainStates.
+
+    The retrace rule needs EVERY input rebuilt per probing call (a
+    donated buffer from call N must never be re-passed at call N+1, and
+    "equivalent but differently constructed" is the whole point), so the
+    real init runs once and each ``fresh()`` re-materializes the pytree
+    from host copies.
+    """
+
+    def __init__(self, cfg: ExperimentConfig):
+        self._cfg = cfg
+        self._host = None
+
+    def fresh(self):
+        import jax
+        import numpy as np
+
+        if self._host is None:
+            from gansformer_tpu.train.state import create_train_state
+
+            state = create_train_state(self._cfg, jax.random.PRNGKey(0))
+            self._host = jax.tree_util.tree_map(np.asarray,
+                                                jax.device_get(state))
+        return jax.tree_util.tree_map(lambda x: np.array(x), self._host)
+
+
+def build_entry_points(config_name: str,
+                       cfg: Optional[ExperimentConfig] = None,
+                       include: Optional[List[str]] = None
+                       ) -> List[EntryPoint]:
+    """EntryPoints for one config.  ``include`` filters by short name
+    (``d_step``, ``g_step``, …); None = all for that config."""
+    import jax
+    import numpy as np
+
+    from gansformer_tpu.train.steps import make_train_steps
+
+    cfg = cfg or trace_configs()[config_name]
+    m, t = cfg.model, cfg.train
+    fns = make_train_steps(cfg, None, batch_size=t.batch_size)
+    state_abs = _abstract_state(cfg)
+    states = _StateFactory(cfg)
+    imgs_abs = jax.ShapeDtypeStruct(
+        (t.batch_size, m.resolution, m.resolution, m.img_channels), np.uint8)
+    key_abs = jax.ShapeDtypeStruct((2,), np.uint32)
+    z_abs = jax.ShapeDtypeStruct(
+        (t.batch_size, m.num_ws, m.latent_dim), np.float32)
+    w_avg_abs = jax.ShapeDtypeStruct((m.w_dim,), np.float32)
+    ts_abs = jax.ShapeDtypeStruct((t.batch_size,), np.float32)
+
+    def imgs():
+        return np.random.RandomState(0).randint(
+            0, 255, imgs_abs.shape, dtype=np.uint8)
+
+    def key(seed: int):
+        return np.asarray(jax.random.PRNGKey(seed))
+
+    def z(seed: int):
+        return np.random.RandomState(seed).normal(
+            size=z_abs.shape).astype(np.float32)
+
+    common = dict(config_name=config_name, compute_dtype=m.dtype)
+    eps: List[EntryPoint] = []
+
+    def add(short, fn, abstract_args, make_args, *, donate=(),
+            static_kwargs=None, train_step=False, arg_specs=()):
+        if include is not None and short not in include:
+            return
+        path, line = def_site(fn)
+        eps.append(EntryPoint(
+            name=f"steps.{short}[{config_name}]", fn=fn,
+            abstract_args=abstract_args, make_args=make_args,
+            static_kwargs=static_kwargs or {}, path=path, line=line,
+            donate_argnums=donate, train_step=train_step,
+            arg_specs=arg_specs, **common))
+
+    add("d_step", fns.d_step, (state_abs, imgs_abs, key_abs),
+        lambda: (states.fresh(), imgs(), key(1)),
+        donate=(0,), train_step=True, arg_specs=("state", "batch", "repl"))
+    add("d_step_r1", fns.d_step_r1, (state_abs, imgs_abs, key_abs),
+        lambda: (states.fresh(), imgs(), key(2)),
+        donate=(0,), train_step=True, arg_specs=("state", "batch", "repl"))
+    add("g_step", fns.g_step, (state_abs, key_abs),
+        lambda: (states.fresh(), key(3)),
+        donate=(0,), train_step=True, arg_specs=("state", "repl"))
+    add("g_step_pl", fns.g_step_pl, (state_abs, key_abs),
+        lambda: (states.fresh(), key(4)),
+        donate=(0,), train_step=True, arg_specs=("state", "repl"))
+    if fns.cycle is not None:
+        k = fns.cycle_len
+        stack_abs = jax.ShapeDtypeStruct((k,) + imgs_abs.shape, np.uint8)
+
+        def stack():
+            return np.random.RandomState(5).randint(
+                0, 255, stack_abs.shape, dtype=np.uint8)
+
+        add("cycle", fns.cycle, (state_abs, stack_abs, key_abs, 0),
+            lambda: (states.fresh(), stack(), key(6), 0),
+            donate=(0,), train_step=True,
+            arg_specs=("state", "stack", "repl", "repl"))
+    add("sample", fns.sample,
+        (state_abs.ema_params, w_avg_abs, z_abs, key_abs),
+        lambda: (states.fresh().ema_params, np.zeros(w_avg_abs.shape,
+                                                     np.float32),
+                 z(7), key(8)),
+        static_kwargs={"truncation_psi": 0.7},
+        arg_specs=("state", "repl", "batch", "repl"))
+    add("ppl_pairs", fns.ppl_pairs,
+        (state_abs.ema_params, z_abs, z_abs, ts_abs, key_abs),
+        lambda: (states.fresh().ema_params, z(9), z(10),
+                 np.linspace(0, 1, t.batch_size).astype(np.float32),
+                 key(11)),
+        static_kwargs={"epsilon": 1e-4},
+        arg_specs=("state", "batch", "batch", "batch", "repl"))
+    return eps
+
+
+# The default trace surface per profile.  Structural rules only trace
+# (no compile), so ``fast`` keeps full entry coverage on the reference
+# config and targets the *added-value* members of the other two: bf16
+# exists only for dtype flow, tiny-fused only for the cycle program.
+FAST_MATRIX = {
+    "tiny-f32": None,                       # all entry points
+    "tiny-bf16": ["d_step_r1", "g_step_pl"],  # superset programs (R1+PL)
+}
+
+
+def build_matrix(profile: str = "fast") -> List[EntryPoint]:
+    out: List[EntryPoint] = []
+    if profile == "fast":
+        for cname, include in FAST_MATRIX.items():
+            out.extend(build_entry_points(cname, include=include))
+    else:
+        for cname in trace_configs():
+            out.extend(build_entry_points(cname))
+    return out

@@ -1,0 +1,82 @@
+"""The trace-analysis driver: entry points × rules → Findings.
+
+Profiles bound the cost (the structural rules only *trace* — Python
+speed; the dynamic rules *execute/compile* — XLA speed):
+
+* ``fast`` — structural rules over the whole fast matrix; the retrace
+  probe on the plain train-step pair (``d_step``/``g_step``) and the
+  sharding audit on ``d_step``, all on the f32 reference config.  This
+  is the tier-1 / ``--selfcheck`` budget (<~1 min cold, mostly cached
+  on re-runs via the persistent compile cache).
+* ``full`` — every rule over every entry point of every matrix config
+  (the ``slow``-marked test and explicit ``--trace-profile full`` runs).
+* ``structural`` — tracing only; never compiles or executes.  Safe in
+  any process (no device-count or cache side effects).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from gansformer_tpu.analysis.findings import Finding
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, all_trace_rules)
+from gansformer_tpu.analysis.trace.entry_points import build_matrix
+
+PROFILES = ("structural", "fast", "full")
+
+# fast-profile dynamic surface (see module docstring)
+_FAST_RETRACE = ("steps.d_step[tiny-f32]", "steps.g_step[tiny-f32]")
+_FAST_SHARDING = ("steps.d_step[tiny-f32]",)
+
+
+def _dynamic_entries(rule_id: str, profile: str,
+                     entries: List[EntryPoint]) -> List[EntryPoint]:
+    if profile == "structural":
+        return []
+    if profile == "full":
+        if rule_id == "sharding-audit":
+            return [ep for ep in entries if ep.arg_specs]
+        return entries
+    wanted = _FAST_SHARDING if rule_id == "sharding-audit" else _FAST_RETRACE
+    return [ep for ep in entries if ep.name in wanted]
+
+
+def run_trace(profile: str = "fast",
+              rules: Optional[Iterable[type]] = None,
+              entries: Optional[List[EntryPoint]] = None
+              ) -> Tuple[List[Finding], TraceContext]:
+    """Run the trace rules; returns (findings, context).  ``entries``
+    overrides the built-in matrix (tests inject fixtures this way) —
+    with an override, profile only selects structural vs dynamic, not
+    which entries the dynamic rules see."""
+    if profile not in PROFILES:
+        raise ValueError(f"unknown trace profile {profile!r}; "
+                         f"have {PROFILES}")
+    rule_classes = list(rules) if rules is not None else all_trace_rules()
+    injected = entries is not None
+    built: List[List[EntryPoint]] = []   # lazy: building the matrix means
+                                         # constructing real train steps —
+                                         # skip it when no rule has targets
+                                         # (e.g. structural + dynamic-only)
+
+    def eps() -> List[EntryPoint]:
+        if not built:
+            built.append(entries if injected else build_matrix(
+                "full" if profile == "full" else "fast"))
+        return built[0]
+
+    ctx = TraceContext()
+    for cls in rule_classes:
+        rule = cls()
+        if rule.dynamic:
+            if profile == "structural":
+                continue
+            targets = (eps() if injected
+                       else _dynamic_entries(rule.id, profile, eps()))
+        else:
+            targets = eps()
+        for ep in targets:
+            rule.check(ep, ctx)
+    ctx.findings.sort(key=Finding.sort_key)
+    return ctx.findings, ctx

@@ -1,0 +1,163 @@
+"""retrace-hazard — a second compile for equivalent inputs.
+
+The most expensive silent bug on a TPU fleet: a jitted function whose
+cache key depends on how the caller *constructed* an input rather than
+what it means — a python scalar one tick and an np scalar the next
+(weak vs strong dtype), a rebuilt static kwarg that hashes differently,
+a closure re-jitted per call.  Every occurrence is a full XLA compile
+(minutes at flagship scale) in the middle of the hot loop.
+
+The probe is empirical, not heuristic: call the real entry point with
+its reference inputs, then again with *equivalent but differently
+constructed* variants —
+
+* ``rebuilt``       — every array freshly allocated (same values,
+                      dtypes, shapes), static kwargs re-created as
+                      equal-but-not-identical objects;
+* ``scalar-flavor`` — python scalars flipped to np scalars and vice
+                      versa (the weak-type axis).
+
+Any cache growth after the first call is a finding.  The static
+companion rule (``retrace-static``, AST side) catches the same family
+in code the harness cannot execute.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional, Tuple
+
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, TraceRule, register)
+
+
+def cache_size(fn) -> Optional[int]:
+    """Number of executables in the jit's in-memory cache — the number
+    of distinct trace keys seen.  Independent of the persistent
+    compilation cache (a disk hit still means a retrace happened)."""
+    probe = getattr(fn, "_cache_size", None)
+    if callable(probe):
+        try:
+            return int(probe())
+        except Exception:
+            return None
+    return None
+
+
+_TRACE_EVENTS = ("jaxpr_trace_duration",)
+_trace_counter = {"n": 0, "installed": False}
+
+
+def _install_trace_counter() -> None:
+    if _trace_counter["installed"]:
+        return
+    from jax import monitoring
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        if any(t in event for t in _TRACE_EVENTS):
+            _trace_counter["n"] += 1
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _trace_counter["installed"] = True
+
+
+def count_traces(fn, call) -> Tuple[Any, int]:
+    """Run ``call()`` and return (result, traces-it-caused).  Prefers the
+    jit cache size delta; falls back to jax.monitoring trace events for
+    wrapped entry points that don't expose a cache."""
+    before = cache_size(fn)
+    if before is not None:
+        result = call()
+        return result, (cache_size(fn) or before) - before
+    _install_trace_counter()
+    n0 = _trace_counter["n"]
+    result = call()
+    return result, _trace_counter["n"] - n0
+
+
+def _flip_scalar(x):
+    import numpy as np
+
+    if isinstance(x, bool) or isinstance(x, np.bool_):
+        return None
+    if isinstance(x, int):
+        return np.int32(x)
+    if isinstance(x, float):
+        return np.float32(x)
+    if isinstance(x, np.integer):
+        return int(x)
+    if isinstance(x, np.floating):
+        return float(x)
+    return None
+
+
+def scalar_flavor_variant(args: tuple) -> Optional[tuple]:
+    """Flip the construction flavor of top-level scalar args (python ↔
+    np) — the weak-type axis of the equivalence matrix.  None when the
+    signature has no scalar args (the variant would be identical)."""
+    flipped = False
+    out = []
+    for a in args:
+        f = _flip_scalar(a)
+        if f is None:
+            out.append(a)
+        else:
+            out.append(f)
+            flipped = True
+    return tuple(out) if flipped else None
+
+
+@register
+class RetraceHazardRule(TraceRule):
+    id = "retrace-hazard"
+    description = ("equivalent-but-differently-constructed inputs caused "
+                   "a second compilation (weak-type / static-kwarg / "
+                   "closure cache-key instability)")
+    hint = ("canonicalize scalar inputs at the jit boundary (int(...) / "
+            "jnp.asarray with an explicit dtype) and keep static kwargs "
+            "hash-stable")
+    dynamic = True
+
+    def check(self, ep: EntryPoint, ctx: TraceContext) -> None:
+        import jax
+
+        if ep.make_args is None:
+            ctx.notes.append(f"{ep.name}: no concrete-input builder; "
+                             f"retrace probe skipped")
+            return
+        try:
+            ref = ep.make_args()
+            out, first = count_traces(
+                ep.fn, lambda: ep.fn(*ref, **ep.static_kwargs))
+            jax.block_until_ready(out)
+        except Exception as e:   # a broken entry point is its own finding
+            ctx.report(self, ep.anchor,
+                       f"{ep.name}: reference call failed during retrace "
+                       f"probe: {type(e).__name__}: {str(e)[:160]}")
+            return
+
+        variants = [
+            ("rebuilt", ep.make_args(),
+             {k: copy.deepcopy(v) for k, v in ep.static_kwargs.items()}),
+        ]
+        flavored = scalar_flavor_variant(ep.make_args())
+        if flavored is not None:
+            variants.append(("scalar-flavor", flavored,
+                             dict(ep.static_kwargs)))
+
+        for label, args, statics in variants:
+            try:
+                out, traced = count_traces(
+                    ep.fn, lambda: ep.fn(*args, **statics))
+                jax.block_until_ready(out)
+            except Exception as e:
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: '{label}' variant call failed: "
+                           f"{type(e).__name__}: {str(e)[:160]}")
+                continue
+            if traced > 0:
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: recompiled for the '{label}' "
+                           f"input variant (equivalent inputs, new cache "
+                           f"entry) — every such call site pays a full "
+                           f"XLA compile at scale")

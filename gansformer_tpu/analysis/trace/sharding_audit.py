@@ -1,0 +1,171 @@
+"""sharding-audit — resolved shardings vs. intent, on a fake 2-device mesh.
+
+Two silent failure modes only visible after GSPMD propagation:
+
+* a parameter above a size threshold whose *resolved* sharding is fully
+  replicated — every device holds a full copy.  Replication is the
+  deliberate data-parallel layout for this model family's small params,
+  so the threshold is what makes the rule meaningful: anything crossing
+  it deserves an explicit sharding decision, not a default.
+* a donated argument whose output sharding differs from its input
+  sharding — XLA cannot alias the buffers, so it inserts a full copy
+  and the donation quietly buys nothing.
+
+The audit runs on a 2-device mesh (tests and the CLI child force
+``--xla_force_host_platform_device_count``), lowers the entry point
+with sharded abstract inputs matching the real loop's placement
+(state replicated, batches on the ``data`` axis), compiles, and reads
+``compiled.input_shardings`` / ``output_shardings``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, TraceRule, register)
+
+REPLICATED_THRESHOLD_BYTES = 8 * 1024 * 1024
+
+
+def _leaf_bytes(aval) -> int:
+    import numpy as np
+
+    try:
+        return int(np.prod(aval.shape) * np.dtype(aval.dtype).itemsize)
+    except Exception:
+        return 0
+
+
+def _path_str(path) -> str:
+    out = []
+    for p in path:
+        out.append(str(getattr(p, "name", getattr(p, "key",
+                                                  getattr(p, "idx", p)))))
+    return "/".join(out)
+
+
+def make_sharded_args(ep: EntryPoint, env) -> Optional[Tuple[Any, ...]]:
+    """``abstract_args`` re-annotated with the real loop's shardings,
+    driven by the entry point's ``arg_specs`` tags."""
+    import jax
+
+    if not ep.arg_specs or len(ep.arg_specs) != len(ep.abstract_args):
+        return None
+    tag_to_sharding = {
+        "state": env.replicated(), "repl": env.replicated(),
+        "batch": env.batch(), "stack": env.batch_stack(),
+    }
+
+    def annotate(leaf, sharding):
+        if leaf is None or not hasattr(leaf, "shape"):
+            return leaf
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                    sharding=sharding)
+
+    out = []
+    for tag, arg in zip(ep.arg_specs, ep.abstract_args):
+        sh = tag_to_sharding[tag]
+        if hasattr(arg, "shape") or arg is None:
+            out.append(annotate(arg, sh))
+        elif isinstance(arg, (int, float)):
+            out.append(arg)                     # scalar — no sharding
+        else:
+            out.append(jax.tree_util.tree_map(
+                lambda l: annotate(l, sh), arg))
+    return tuple(out)
+
+
+def _equivalent(a, b, ndim: int) -> bool:
+    try:
+        return bool(a.is_equivalent_to(b, ndim))
+    except Exception:
+        return str(a) == str(b)
+
+
+@register
+class ShardingAuditRule(TraceRule):
+    id = "sharding-audit"
+    description = ("resolved sharding defeats intent: oversize fully-"
+                   "replicated parameter, or donated input whose output "
+                   "sharding differs (donation degrades to a copy)")
+    hint = ("give big params an explicit NamedSharding (or shard them "
+            "over the model axis); keep donated outputs on the same "
+            "sharding as their inputs")
+    dynamic = True
+
+    replicated_threshold = REPLICATED_THRESHOLD_BYTES
+
+    def check(self, ep: EntryPoint, ctx: TraceContext) -> None:
+        import jax
+
+        from gansformer_tpu.core.config import MeshConfig
+        from gansformer_tpu.parallel.mesh import make_mesh
+
+        devices = jax.devices()
+        if len(devices) < 2:
+            ctx.notes.append(
+                f"{ep.name}: sharding audit needs ≥2 devices (run under "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count=2); "
+                f"skipped")
+            return
+        env = make_mesh(MeshConfig(data=2, model=1), devices=devices[:2])
+        args = make_sharded_args(ep, env)
+        if args is None:
+            ctx.notes.append(f"{ep.name}: no arg_specs; sharding audit "
+                             f"skipped")
+            return
+        try:
+            with env.activate():
+                compiled = ep.fn.lower(*args, **ep.static_kwargs).compile()
+        except Exception as e:
+            ctx.report(self, ep.anchor,
+                       f"{ep.name}: sharded lowering failed: "
+                       f"{type(e).__name__}: {str(e)[:160]}")
+            return
+
+        in_tree = compiled.input_shardings[0]
+        flat_in, _ = jax.tree_util.tree_flatten(in_tree)
+        in_leaves = jax.tree_util.tree_flatten_with_path(args)[0]
+        if len(flat_in) != len(in_leaves):
+            ctx.notes.append(f"{ep.name}: input sharding arity mismatch; "
+                             f"audit skipped")
+            return
+
+        # -- oversize fully-replicated params --------------------------------
+        for (path, aval), sharding in zip(in_leaves, flat_in):
+            if not hasattr(aval, "shape"):
+                continue
+            n = _leaf_bytes(aval)
+            if n < self.replicated_threshold:
+                continue
+            if getattr(sharding, "is_fully_replicated", False):
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: input {_path_str(path)} "
+                           f"({n / 2**20:.1f} MiB) resolves fully "
+                           f"replicated — every device holds a copy")
+
+        # -- donated input vs output sharding --------------------------------
+        # Repo convention: donate_argnums == (0,) and output[0] is the
+        # updated version of the donated pytree (same treedef).
+        if ep.donate_argnums != (0,):
+            return
+        flat_out, _ = jax.tree_util.tree_flatten(compiled.output_shardings)
+        state_leaves = jax.tree_util.tree_flatten_with_path(args[0])[0]
+        n_state = len(state_leaves)
+        if len(flat_out) < n_state:
+            ctx.notes.append(f"{ep.name}: output sharding arity "
+                             f"({len(flat_out)}) smaller than donated "
+                             f"input ({n_state}); donation audit skipped")
+            return
+        in_state_shardings = flat_in[:n_state]
+        out_state_shardings = flat_out[:n_state]
+        for (path, aval), s_in, s_out in zip(
+                state_leaves, in_state_shardings, out_state_shardings):
+            ndim = len(getattr(aval, "shape", ()))
+            if not _equivalent(s_in, s_out, ndim):
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: donated arg leaf "
+                           f"{_path_str(path)} changes sharding "
+                           f"{s_in} → {s_out}; XLA must copy instead of "
+                           f"aliasing, defeating donation")

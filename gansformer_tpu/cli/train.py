@@ -75,6 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
                    const=False, dest="async_checkpoint",
                    help="synchronous checkpoint/snapshot writes on the "
                         "loop thread (parity fallback)")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="run graftlint (AST + jaxpr trace rules) before "
+                        "training; writes <run_dir>/graftlint.json and "
+                        "aborts on NEW findings — catch a retrace storm "
+                        "or dtype leak before it burns accelerator hours")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans + per-tick finite checks")
     p.add_argument("--profile-dir", default=None,
@@ -225,6 +230,41 @@ def main(argv=None) -> None:
             f.write(cfg.to_json())
     logger = RunLogger(run_dir, active=is_main)
     logger.write(f"run dir: {run_dir}")
+    if args.selfcheck:
+        # Pre-flight: the whole analysis stack (AST rules + jaxpr trace
+        # rules) in one pass, machine-readable artifact in the run dir.
+        # New findings abort BEFORE any accelerator time is spent.
+        # Process 0 runs the check; the verdict is broadcast so every
+        # process aborts together instead of peers hanging in train()'s
+        # first collective against a dead coordinator.
+        n_new = 0
+        if is_main:
+            from gansformer_tpu.analysis.cli import run_selfcheck
+
+            try:
+                n_new = run_selfcheck(run_dir)
+                logger.write(f"selfcheck: {n_new} new finding(s) "
+                             f"({os.path.join(run_dir, 'graftlint.json')})")
+            except Exception as e:
+                # a crashed selfcheck must still reach the broadcast
+                # below — otherwise the peers block in the collective
+                # against a dead coordinator instead of aborting
+                logger.write(f"selfcheck crashed: {type(e).__name__}: "
+                             f"{str(e)[:300]}")
+                n_new = -1
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            import numpy as np
+
+            n_new = int(multihost_utils.broadcast_one_to_all(
+                np.int32(n_new)))
+        if n_new:
+            raise SystemExit(
+                "--selfcheck: the check itself crashed (see log.txt)"
+                if n_new < 0 else
+                f"--selfcheck: {n_new} new graftlint finding(s); see "
+                f"{os.path.join(run_dir, 'graftlint.json')} — fix, "
+                f"suppress with a justification, or baseline, then rerun")
     train(cfg, run_dir, resume=args.resume, logger=logger)
 
 

@@ -56,3 +56,40 @@ def install_compile_listener() -> bool:
         return False
     _COMPILE_LISTENER["installed"] = True
     return True
+
+
+class RetraceWatch:
+    """Counts compiles past the warm-up boundary into
+    ``compile/retraces_total`` (ISSUE 4 satellite).
+
+    The trace-level ``retrace-hazard`` rule statically predicts "this
+    entry point compiles exactly once"; this watch is the runtime
+    cross-check: every XLA compile the ``install_compile_listener``
+    stream sees AFTER ``arm()`` (the train loop arms at the first tick
+    boundary, when all step variants have compiled) is by definition a
+    retrace — equivalent work re-entering the compiler mid-run.  A
+    nonzero ``compile/retraces_total`` in telemetry.prom is the
+    production symptom the static rule exists to prevent; disagreement
+    between the two is a bug report against either side.
+    """
+
+    def __init__(self):
+        self._baseline = None
+
+    def arm(self) -> None:
+        """Freeze the warm-up compile count; later compiles are
+        retraces.  Also materializes the counter so telemetry shows an
+        explicit 0 from the first armed tick."""
+        self._baseline = counter("xla/compile_count").value
+        counter("compile/retraces_total")
+
+    def poll(self) -> float:
+        """Fold new post-warm-up compiles into the counter; returns the
+        running total.  Cheap — two registry lookups; call per tick."""
+        if self._baseline is None:
+            return 0.0
+        seen = counter("xla/compile_count").value - self._baseline
+        c = counter("compile/retraces_total")
+        if seen > c.value:
+            c.inc(seen - c.value)
+        return c.value

@@ -131,6 +131,11 @@ def _train(cfg: ExperimentConfig, run_dir: str,
         process_index=jax.process_index(),
         truncate=not resume)
     obs.install_compile_listener()     # xla/compile_count + xla/compile_ms
+    # Post-warm-up compiles are retraces (compile/retraces_total) — the
+    # runtime cross-check of the static retrace-hazard trace rule: armed
+    # at the first tick boundary (all step variants compiled by then),
+    # polled every tick.  docs/observability.md "Compilation".
+    retrace_watch = obs.RetraceWatch()
     # Heartbeat: EVERY process writes its own liveness file so a stalled
     # peer is visible from outside while the survivors sit in a collective.
     # The first beat waits until state/restore resolves cur_nimg — beating
@@ -518,6 +523,10 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                     sec_per_it = sec_per_tick / (imgs_done / t.batch_size)
                     stats["timing/mfu"] = (
                         flops_per_it / sec_per_it / (peak * 1e12))
+                if tick == 0:
+                    retrace_watch.arm()    # warm-up compiles end here
+                else:
+                    retrace_watch.poll()
                 log.log_tick(stats, telemetry=obs.get_registry().snapshot())
                 heartbeat.beat(step=cur_nimg, kimg=cur_nimg / 1000)
                 if jax.process_index() == 0:

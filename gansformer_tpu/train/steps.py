@@ -76,6 +76,31 @@ class TrainStepFns:
     ppl_pairs: Callable[..., Tuple[jax.Array, jax.Array]]
 
 
+def _wrap_cycle(cycle_jit, wrapped):
+    """The fused cycle's jit-boundary shim (module-level so the it0
+    canonicalization is unit-testable without compiling the cycle)."""
+
+    @functools.wraps(wrapped)
+    def cycle_fn(state, imgs_k, rng, it0, label_k=None):
+        # int() pins it0's trace-key flavor at the jit boundary: a
+        # python int and an np.int32 of the same value hash to
+        # different avals (weak vs strong dtype), and each flavor
+        # would pay a full XLA compile of the largest program in the
+        # repo (found by the retrace-hazard trace rule, ISSUE 4).
+        # Tracers pass through: make_jaxpr/eval_shape trace this
+        # wrapper too, and an abstract it0 cannot (and need not)
+        # be concretized.
+        if not isinstance(it0, jax.core.Tracer):
+            it0 = int(it0)
+        return cycle_jit(state, imgs_k, rng, it0, label_k)
+
+    # bench.py compiles via lower(); the retrace probe reads the
+    # trace-cache size — both live on the underlying jit object.
+    cycle_fn.lower = cycle_jit.lower
+    cycle_fn._cache_size = getattr(cycle_jit, "_cache_size", None)
+    return cycle_fn
+
+
 def _sample_z(cfg, rng, batch):
     m = cfg.model
     return jax.random.normal(rng, (batch, m.num_ws, m.latent_dim), jnp.float32)
@@ -337,12 +362,16 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
     donate_state = dict(donate_argnums=(0,))
     sample = jax.jit(_sample, static_argnames=("truncation_psi",))
     _ = env  # sharding comes from the inputs; env kept for API symmetry
+
+    cycle_fn = _wrap_cycle(jax.jit(_cycle, **donate_state), _cycle) \
+        if can_cycle else None
+
     fns = TrainStepFns(
         d_step=jax.jit(functools.partial(_d_step, do_r1=False), **donate_state),
         d_step_r1=jax.jit(functools.partial(_d_step, do_r1=True), **donate_state),
         g_step=jax.jit(functools.partial(_g_step, do_pl=False), **donate_state),
         g_step_pl=jax.jit(functools.partial(_g_step, do_pl=True), **donate_state),
-        cycle=jax.jit(_cycle, **donate_state) if can_cycle else None,
+        cycle=cycle_fn,
         cycle_len=d_reg if can_cycle else 0,
         cycle_counts=cycle_counts,
         sample=sample,

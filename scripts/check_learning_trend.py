@@ -1,119 +1,34 @@
 """Assert that a training run actually LEARNED (VERDICT r4 item 4).
 
-The reference's verification model is golden-metric empiricism: train,
-then watch FID fall (SURVEY.md §4 item 1).  This checker makes that an
-assertable artifact property: given a run dir, it reads the recorded
-``metric-*.txt`` series (written by the tick loop / evaluate CLI) and
-``stats.jsonl``, and asserts
-
-  * >= ``--min-points`` metric evaluations exist,
-  * the metric IMPROVED: last fitted value < first fitted value by
-    >= ``--min-drop`` (relative), using a least-squares line over the
-    series so a noisy final tick cannot fake or hide a trend,
-  * losses in stats.jsonl stayed finite throughout.
-
-Prints one JSON line {ok, metric, first, last, fit_drop_rel, points};
-exit code 0 iff ok.  Used by tests/test_learning_trend.py (synthetic
-artifacts) and on the committed learning-evidence run (PERF.md §5).
+SHIM — the checker now lives in the graftlint framework as
+``gansformer_tpu/analysis/learning_trend.py`` (the ``learning-trend``
+run-dir rule, ISSUE 4); this script keeps the original entry point and
+module API (``check`` / ``read_metric_series`` / ``fit_line``, result
+shape ``{ok, metric, first, last, fit_drop_rel, points}``) so existing
+invocations and tests keep working:
 
   python scripts/check_learning_trend.py <run_dir> [--metric fid512_uncal]
+
+Prefer ``gansformer-lint --run-dir <dir> --learning-trend`` for new
+wiring; see docs/static-analysis.md.
 """
 
 from __future__ import annotations
 
-import argparse
-import glob
-import json
 import os
-import re
 import sys
 
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:          # direct `python scripts/…` invocation
+    sys.path.insert(0, _ROOT)
 
-def read_metric_series(run_dir: str, metric: str | None):
-    """[(kimg, value)] from metric-<name>.txt (tick-loop format:
-    'kimg <k> <name> <v>').  metric=None picks the first fid* file."""
-    if metric:
-        paths = [os.path.join(run_dir, f"metric-{metric}.txt")]
-    else:
-        paths = sorted(glob.glob(os.path.join(run_dir, "metric-fid*.txt")))
-    if not paths or not os.path.exists(paths[0]):
-        return None, []
-    name = os.path.basename(paths[0])[len("metric-"):-len(".txt")]
-    series = []
-    with open(paths[0]) as f:
-        for line in f:
-            m = re.match(r"kimg\s+([\d.]+)\s+\S+\s+([\d.eE+-]+)", line)
-            if m:
-                series.append((float(m.group(1)), float(m.group(2))))
-    return name, series
-
-
-def fit_line(series):
-    """Least-squares (intercept, slope) over (kimg, value)."""
-    n = len(series)
-    xs = [k for k, _ in series]
-    ys = [v for _, v in series]
-    mx, my = sum(xs) / n, sum(ys) / n
-    var = sum((x - mx) ** 2 for x in xs) or 1e-12
-    slope = sum((x - mx) * (y - my) for x, y in series) / var
-    return my - slope * mx, slope
-
-
-def check(run_dir: str, metric: str | None, min_points: int,
-          min_drop: float) -> dict:
-    name, series = read_metric_series(run_dir, metric)
-    out = {"ok": False, "run_dir": run_dir, "metric": name,
-           "points": len(series)}
-    if len(series) < min_points:
-        out["error"] = (f"only {len(series)} metric points "
-                        f"(need >= {min_points})")
-        return out
-    b, a = fit_line(series)
-    first_fit = b + a * series[0][0]
-    last_fit = b + a * series[-1][0]
-    drop = (first_fit - last_fit) / abs(first_fit) if first_fit else 0.0
-    out.update({
-        "first": round(series[0][1], 4), "last": round(series[-1][1], 4),
-        "first_fit": round(first_fit, 4), "last_fit": round(last_fit, 4),
-        "fit_drop_rel": round(drop, 4), "slope_per_kimg": round(a, 6),
-    })
-    if drop < min_drop:
-        out["error"] = (f"fitted {name} fell only {drop * 100:.1f}% "
-                        f"(need >= {min_drop * 100:.0f}%) — no learning "
-                        f"evidence")
-        return out
-    stats_path = os.path.join(run_dir, "stats.jsonl")
-    if os.path.exists(stats_path):
-        import math
-
-        for line in open(stats_path):
-            row = json.loads(line)
-            for k, v in row.items():
-                if k.startswith("Loss/") and isinstance(v, float) \
-                        and not math.isfinite(v):
-                    out["error"] = f"non-finite {k} at tick " \
-                                   f"{row.get('Progress/tick')}"
-                    return out
-    out["ok"] = True
-    return out
-
-
-def main(argv=None) -> int:
-    # argv-parameterized and side-effect-free on import, so the analysis
-    # test suite can import and drive every script it shims (ISSUE 3):
-    # parse_args/sys.exit only run under __main__ or an explicit call.
-    p = argparse.ArgumentParser()
-    p.add_argument("run_dir")
-    p.add_argument("--metric", default=None,
-                   help="metric name (default: first metric-fid*.txt)")
-    p.add_argument("--min-points", type=int, default=3)
-    p.add_argument("--min-drop", type=float, default=0.10,
-                   help="required relative drop of the fitted line")
-    args = p.parse_args(argv)
-    out = check(args.run_dir, args.metric, args.min_points, args.min_drop)
-    print(json.dumps(out))
-    return 0 if out["ok"] else 1
-
+from gansformer_tpu.analysis.learning_trend import (  # noqa: E402,F401
+    check,
+    fit_line,
+    lint_learning_trend,
+    main,
+    read_metric_series,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

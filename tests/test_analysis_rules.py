@@ -152,6 +152,35 @@ def per_metric(name):
     return telemetry.gauge(f"metric/{name}/duration_s")
 """
 
+RETRACE_STATIC_BAD = """
+import jax
+
+_SCHEDULE = {"lr": 0.1}
+
+def _apply(x, lr):
+    return x * lr * _SCHEDULE["lr"]
+
+step = jax.jit(_apply, static_argnums=(1,))
+
+def tick(x, i):
+    _SCHEDULE["lr"] = 0.1 / (i + 1)       # mutated after trace
+    return step(x, [0.1, 0.2])            # unhashable static arg
+"""
+
+RETRACE_STATIC_OK = """
+import jax
+
+_ACTIVATIONS = {"relu": 1}     # never mutated: a de-facto constant
+
+def _apply(x, lr):
+    return x * lr * _ACTIVATIONS["relu"]
+
+step = jax.jit(_apply, static_argnums=(1,))
+
+def tick(x):
+    return step(x, 0.1)        # hashable scalar static
+"""
+
 CASES = [
     ("host-sync-in-jit", HOST_SYNC_BAD, HOST_SYNC_OK),
     ("donation-after-use", DONATION_BAD, DONATION_OK),
@@ -159,6 +188,7 @@ CASES = [
     ("hot-loop-sync", HOT_LOOP_BAD, HOT_LOOP_OK),
     ("thread-shared-state", THREAD_BAD, THREAD_OK),
     ("telemetry-name-convention", TELEMETRY_BAD, TELEMETRY_OK),
+    ("retrace-static", RETRACE_STATIC_BAD, RETRACE_STATIC_OK),
 ]
 
 
@@ -280,6 +310,36 @@ step = jax.jit(functools.partial(_step, ), donate_argnums=(0,))
 """
     findings = run_rule("host-sync-in-jit", src)
     assert any(f.line == 6 for f in findings), findings
+
+
+def test_jit_region_lambda_wrap():
+    """Regression (ISSUE 4 satellite): ``step = jax.jit(lambda s, b:
+    _step(s, b))`` must pull ``_step`` into the jit region — the
+    resolver previously only covered decorator/call-wrap/partial."""
+    src = """
+import jax
+
+def _step(s, b):
+    print("silent")          # host sync — must be flagged
+    return s + b
+
+step = jax.jit(lambda s, b: _step(s, b), donate_argnums=(0,))
+"""
+    findings = run_rule("host-sync-in-jit", src)
+    assert any(f.line == 5 for f in findings), findings
+    # donation through the lambda wrap resolves to the assigned name too
+    tree = ast.parse(src)
+    assert JitIndex(tree).donating.get("step") == (0,)
+    # lambda parameters don't leak as region references
+    src_shadow = """
+import jax
+
+def helper(x):
+    return float(x)
+
+step = jax.jit(lambda helper: helper + 1)   # param shadows the def
+"""
+    assert run_rule("host-sync-in-jit", src_shadow) == []
 
 
 def test_jit_index_resolves_real_steps_module():

@@ -223,6 +223,16 @@ def test_loop_telemetry_artifacts(micro_run_dir):
     assert result["ok"], result["errors"]
     res = check_heartbeats(d, max_age_s=24 * 3600.0, expected=[0])
     assert res["ok"], res
+    # Retrace cross-check (ISSUE 4 satellite): the watch armed at tick
+    # 0's boundary; every later tick's record must carry the counter —
+    # and a clean run must show ZERO post-warm-up compiles, the runtime
+    # confirmation of the static retrace-hazard rule's prediction.
+    later = [rec for rec in lines if rec.get("Progress/tick", 0) >= 1]
+    assert later
+    for rec in later:
+        assert rec["telemetry"]["counters"]["compile/retraces_total"] == 0.0
+    prom = open(os.path.join(d, "telemetry.prom")).read()
+    assert "compile_retraces_total 0.0" in prom
 
 
 def test_read_events_skips_torn_final_line(tmp_path):

@@ -1,0 +1,304 @@
+"""Fixture tests for the jaxpr-level trace rules (ISSUE 4): every rule
+family has a FIRES case (seeded defect), a QUIET case (correct code),
+and suppression + baseline handling over the same fixtures — mirroring
+tests/test_analysis_rules.py for the AST half.
+
+The fixture functions live in THIS file so findings anchor on real
+source lines here (trace findings carry file:line like AST findings;
+inline ``# graftlint: disable=`` on the anchored line suppresses)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gansformer_tpu.analysis.baseline import Baseline
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, def_site, line_text)
+from gansformer_tpu.analysis.trace.const_bloat import ConstBloatRule
+from gansformer_tpu.analysis.trace.dtype_flow import DtypePromotionRule
+from gansformer_tpu.analysis.trace.retrace import (
+    RetraceHazardRule, scalar_flavor_variant)
+from gansformer_tpu.analysis.trace.sharding_audit import ShardingAuditRule
+
+VEC = jax.ShapeDtypeStruct((4,), np.float32)
+
+
+def ep_for(fn, *abstract_args, jit_kwargs=None, **fields):
+    jitted = jax.jit(fn, **(jit_kwargs or {}))
+    path, line = def_site(jitted)
+    return EntryPoint(name=f"fixture.{fn.__name__}", fn=jitted,
+                      abstract_args=abstract_args, path=path, line=line,
+                      **fields)
+
+
+def run_one(rule_cls, ep):
+    ctx = TraceContext()
+    rule_cls().check(ep, ctx)
+    return ctx.findings
+
+
+def roundtrip_baseline(rule_cls, make_ep, tmp_path):
+    """fires → write baseline → fresh run is baselined, not new.
+    ``make_ep`` builds a FRESH entry point per run (the retrace probe
+    leaves its variants in the jit cache — a reused fn can't re-fire)."""
+    findings = run_one(rule_cls, make_ep())
+    assert findings
+
+    def text_of(f):
+        return line_text(f.path, f.line)
+
+    bl = str(tmp_path / "baseline.json")
+    Baseline.write(bl, findings, text_of)
+    fresh = run_one(rule_cls, make_ep())
+    Baseline.load(bl).apply(fresh, text_of)
+    assert all(f.baselined and not f.new for f in fresh)
+
+
+# --- jaxpr-const-bloat ------------------------------------------------------
+
+_BIG = np.zeros((220, 220), np.float32)        # ~189 KiB > 64 KiB threshold
+
+
+def _const_leaker(x):
+    return x + jnp.asarray(_BIG).sum()
+
+
+def _const_leaker_suppressed(x):  # graftlint: disable=jaxpr-const-bloat — fixture: suppression contract
+    return x + jnp.asarray(_BIG).sum()
+
+
+def _const_small(x):
+    return x + jnp.asarray(np.ones((8,), np.float32)).sum()
+
+
+def test_const_bloat_fires():
+    findings = run_one(ConstBloatRule, ep_for(_const_leaker, VEC))
+    assert len(findings) == 1 and findings[0].new
+    assert "KiB" in findings[0].message
+    assert findings[0].path.endswith("test_trace_rules.py")
+
+
+def test_const_bloat_quiet():
+    assert run_one(ConstBloatRule, ep_for(_const_small, VEC)) == []
+
+
+def test_const_bloat_suppressed():
+    findings = run_one(ConstBloatRule,
+                       ep_for(_const_leaker_suppressed, VEC))
+    assert len(findings) == 1
+    assert findings[0].suppressed and not findings[0].new
+
+
+def test_const_bloat_baselined(tmp_path):
+    roundtrip_baseline(ConstBloatRule,
+                       lambda: ep_for(_const_leaker, VEC), tmp_path)
+
+
+# --- dtype-promotion --------------------------------------------------------
+
+BVEC = jax.ShapeDtypeStruct((4,), jnp.bfloat16)
+
+
+def _promotes_bf16(x):
+    return x + jnp.arange(4.0)
+
+
+def _promotes_bf16_suppressed(x):
+    return x + jnp.arange(4.0)  # graftlint: disable=dtype-promotion — fixture: suppression contract
+    # (the comment sits on the PROMOTING line — dtype findings anchor
+    # there, not on the def)
+
+
+def _explicit_upcast(x):
+    return x.astype(jnp.float32) + jnp.arange(4.0)
+
+
+def test_dtype_promotion_fires_on_silent_bf16_upcast():
+    findings = run_one(DtypePromotionRule,
+                       ep_for(_promotes_bf16, BVEC,
+                              compute_dtype="bfloat16"))
+    assert len(findings) == 1 and findings[0].new
+    assert "bfloat16" in findings[0].message
+    # anchored on the promoting line, not the def line
+    assert "jnp.arange(4.0)" in line_text(findings[0].path,
+                                          findings[0].line)
+
+
+def test_dtype_promotion_quiet_when_cast_is_written():
+    findings = run_one(DtypePromotionRule,
+                       ep_for(_explicit_upcast, BVEC,
+                              compute_dtype="bfloat16"))
+    assert findings == []
+
+
+def test_dtype_promotion_quiet_on_f32_model():
+    # in an all-f32 model only →f64 would be a leak; bf16→f32 can't occur
+    findings = run_one(DtypePromotionRule,
+                       ep_for(_promotes_bf16, VEC,
+                              compute_dtype="float32"))
+    assert findings == []
+
+
+def test_dtype_promotion_suppressed():
+    findings = run_one(DtypePromotionRule,
+                       ep_for(_promotes_bf16_suppressed, BVEC,
+                              compute_dtype="bfloat16"))
+    assert len(findings) == 1
+    assert findings[0].suppressed and not findings[0].new
+
+
+def test_dtype_promotion_baselined(tmp_path):
+    roundtrip_baseline(
+        DtypePromotionRule,
+        lambda: ep_for(_promotes_bf16, BVEC, compute_dtype="bfloat16"),
+        tmp_path)
+
+
+# --- retrace-hazard ---------------------------------------------------------
+
+def _scalar_lr_step(lr, x):
+    return x * lr
+
+
+def _scalar_lr_step_suppressed(lr, x):  # graftlint: disable=retrace-hazard — fixture: suppression contract
+    return x * lr
+
+
+def _arrays_only(x):
+    return x * 2.0
+
+
+def _fresh_clone(fn):
+    """A new function object with fn's code — jax.jit keys its tracing
+    cache on the function object, so re-jitting the SAME fn reuses
+    cache entries from earlier probes; each probe needs its own."""
+    import functools
+    import types
+
+    clone = types.FunctionType(fn.__code__, fn.__globals__, fn.__name__,
+                               fn.__defaults__, fn.__closure__)
+    return functools.wraps(fn)(clone)
+
+
+def _lr_ep(fn):
+    # the seeded regression (ISSUE 4 acceptance): a python float from
+    # enclosing state reaches the jit boundary as a traced argument —
+    # the next caller passing np.float32 (same value!) pays a recompile
+    lr = 0.5
+    return ep_for(_fresh_clone(fn), jax.ShapeDtypeStruct((), np.float32),
+                  VEC, make_args=lambda: (lr, np.ones((4,), np.float32)))
+
+
+def test_retrace_catches_seeded_python_float_regression():
+    findings = run_one(RetraceHazardRule, _lr_ep(_scalar_lr_step))
+    assert len(findings) == 1 and findings[0].new
+    assert "scalar-flavor" in findings[0].message
+
+
+def test_retrace_quiet_on_array_only_signature():
+    ep = ep_for(_arrays_only, VEC,
+                make_args=lambda: (np.ones((4,), np.float32),))
+    assert run_one(RetraceHazardRule, ep) == []
+
+
+def test_retrace_suppressed():
+    findings = run_one(RetraceHazardRule,
+                       _lr_ep(_scalar_lr_step_suppressed))
+    assert len(findings) == 1
+    assert findings[0].suppressed and not findings[0].new
+
+
+def test_retrace_baselined(tmp_path):
+    roundtrip_baseline(RetraceHazardRule,
+                       lambda: _lr_ep(_scalar_lr_step), tmp_path)
+
+
+def test_scalar_flavor_variant_builder():
+    args = (0.5, 3, np.ones((2,), np.float32), None)
+    flipped = scalar_flavor_variant(args)
+    assert isinstance(flipped[0], np.float32)
+    assert isinstance(flipped[1], np.int32)
+    assert flipped[2] is args[2] and flipped[3] is None
+    assert scalar_flavor_variant((np.ones((2,)),)) is None   # no scalars
+
+
+# --- sharding-audit ---------------------------------------------------------
+
+def _batch_sharding():
+    from gansformer_tpu.core.config import MeshConfig
+    from gansformer_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(MeshConfig(data=2, model=1),
+                     devices=jax.devices()[:2]).batch()
+
+
+MAT = jax.ShapeDtypeStruct((8, 4), np.float32)
+# crosses the 8 MiB replicated-parameter threshold (2100*1024*4 ≈ 8.2 MiB)
+GIANT = jax.ShapeDtypeStruct((2100, 1024), np.float32)
+
+_BATCH_SH = None
+
+
+def _resharding_donor(s):
+    return jax.lax.with_sharding_constraint(s + 1.0, _BATCH_SH)
+
+
+def _resharding_donor_suppressed(s):  # graftlint: disable=sharding-audit — fixture: suppression contract
+    return jax.lax.with_sharding_constraint(s + 1.0, _BATCH_SH)
+
+
+def _stable_donor(s):
+    return s + 1.0
+
+
+def _giant_reader(p):
+    return p.sum()
+
+
+@pytest.fixture(autouse=True)
+def _bind_batch_sharding():
+    global _BATCH_SH
+    if _BATCH_SH is None and len(jax.devices()) >= 2:
+        _BATCH_SH = _batch_sharding()
+    yield
+
+
+def _donor_ep(fn):
+    return ep_for(fn, MAT, jit_kwargs={"donate_argnums": (0,)},
+                  donate_argnums=(0,), arg_specs=("repl",))
+
+
+def test_sharding_audit_fires_on_donation_resharding():
+    findings = run_one(ShardingAuditRule, _donor_ep(_resharding_donor))
+    assert len(findings) == 1 and findings[0].new
+    assert "defeating donation" in findings[0].message
+
+
+def test_sharding_audit_fires_on_oversize_replicated_param():
+    ep = ep_for(_giant_reader, GIANT, arg_specs=("repl",))
+    findings = run_one(ShardingAuditRule, ep)
+    assert len(findings) == 1 and findings[0].new
+    assert "fully replicated" in findings[0].message
+
+
+def test_sharding_audit_quiet_on_stable_donation():
+    assert run_one(ShardingAuditRule, _donor_ep(_stable_donor)) == []
+
+
+def test_sharding_audit_quiet_on_batch_sharded_input():
+    ep = ep_for(_giant_reader, GIANT, arg_specs=("batch",))
+    assert run_one(ShardingAuditRule, ep) == []
+
+
+def test_sharding_audit_suppressed():
+    findings = run_one(ShardingAuditRule,
+                       _donor_ep(_resharding_donor_suppressed))
+    assert len(findings) == 1
+    assert findings[0].suppressed and not findings[0].new
+
+
+def test_sharding_audit_baselined(tmp_path):
+    roundtrip_baseline(ShardingAuditRule,
+                       lambda: _donor_ep(_resharding_donor), tmp_path)
