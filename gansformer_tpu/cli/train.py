@@ -45,6 +45,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d-lr", type=float)
     p.add_argument("--r1-gamma", type=float)
     p.add_argument("--seed", type=int)
+    # MFU levers (ISSUE 5): prepared, flag-gated step-time variants — the
+    # A/B battery (scripts/ab_levers.py) prices them; these flags arm them
+    # for a real run once a measured Δms justifies it (PERF.md §1d).
+    p.add_argument("--pl-batch-shrink", type=int, default=None,
+                   help="path-length probe batch divisor (reference "
+                        "default 2; 1 = full-batch probe, 4 = prepared "
+                        "cheaper variant)")
+    p.add_argument("--r1-batch-shrink", type=int, default=None,
+                   help="compute R1 on the first batch/N reals (unbiased "
+                        "slice estimator, lazy-reg weight unchanged); "
+                        "default 1 = off")
+    p.add_argument("--attn-fused-kv", action="store_const", const=True,
+                   dest="attn_fused_kv", default=None,
+                   help="fuse each attention direction's K/V projections "
+                        "into one matmul (exact math, different param "
+                        "tree; default off)")
+    p.add_argument("--no-attn-fused-kv", action="store_const", const=False,
+                   dest="attn_fused_kv",
+                   help="disable the fused K/V projection (overrides a "
+                        "loaded config that enabled it)")
     p.add_argument("--fused-cycle", action="store_const", const=True,
                    dest="fused_cycle", default=None,
                    help="dispatch one jitted program per full lazy-reg "
@@ -133,9 +153,14 @@ def config_from_args(args) -> ExperimentConfig:
     sp = getattr(args, "sequence_parallel", None)
     if sp is not None:            # tri-state: None inherits the config
         model = dataclasses.replace(model, sequence_parallel=sp)
+    fkv = getattr(args, "attn_fused_kv", None)
+    if fkv is not None:           # tri-state: None inherits the config
+        model = dataclasses.replace(model, attn_fused_kv=fkv)
     train = override(cfg.train, batch_size=args.batch_size,
                      total_kimg=args.total_kimg, g_lr=args.g_lr,
-                     d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed)
+                     d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed,
+                     pl_batch_shrink=getattr(args, "pl_batch_shrink", None),
+                     r1_batch_shrink=getattr(args, "r1_batch_shrink", None))
     fc = getattr(args, "fused_cycle", None)
     if fc is not None:                # tri-state: None inherits the config
         train = dataclasses.replace(train, fused_cycle=fc)

@@ -86,6 +86,16 @@ class ModelConfig:
     # for sampling / metric sweeps (generate/evaluate --attention-backend),
     # never the training step.
     attention_backend: str = "xla"
+    # MFU lever (ISSUE 5, default OFF): fuse the attention K/V projections
+    # into ONE matmul per direction — the duplex centroid phase's k_x/v_x
+    # both project the n = H·W grid (the expensive read at 128²), and the
+    # main phase's k_y/v_y both project the latents.  Mathematically exact
+    # (concatenated weight columns; parity-tested in tests/test_levers.py);
+    # the win, if any, is dispatch count + one grid read instead of two —
+    # FLOPs are identical, so only the on-chip A/B (scripts/ab_levers.py)
+    # can price it.  Changes the param tree: not checkpoint-compatible
+    # with the unfused layout.
+    attn_fused_kv: bool = False
     # NO remat flag, deliberately: per-block jax.checkpoint was measured to
     # INCREASE g_step_pl temp workspace at ffhq1024/batch-8 (16.85 →
     # 21.20 GiB) — second-order PL grads recompute through the checkpoint
@@ -154,8 +164,19 @@ class TrainConfig:
     r1_gamma: float = 10.0
     d_reg_interval: int = 16
     g_reg_interval: int = 4
+    # MFU lever (ISSUE 5): compute R1 on the first batch/r1_batch_shrink
+    # reals only.  The slice mean is an unbiased estimator of the batch
+    # mean, so the (γ/2)·interval lazy-reg weight needs NO further
+    # compensation — only the estimator's variance grows.  Default 1 =
+    # OFF (reference semantics); acceptance contract in tests/test_levers.
+    r1_batch_shrink: int = 1
     pl_weight: float = 2.0
     pl_decay: float = 0.01
+    # StyleGAN2's own PL cost bound (reference pl_batch_shrink): the PL
+    # probe synthesizes batch/pl_batch_shrink fresh samples.  2 is the
+    # reference default (the measured BASELINE); 1 = full-batch probe
+    # (the expectation-parity reference), 4 = the prepared step-time
+    # variant scripts/ab_levers.py prices against it on chip.
     pl_batch_shrink: int = 2
     style_mixing_prob: float = 0.9
 
@@ -289,9 +310,19 @@ class ExperimentConfig:
             errs.append(f"model.components must be ≥ 1, got {m.components}")
         if t.batch_size < 1:
             errs.append(f"train.batch_size must be ≥ 1, got {t.batch_size}")
-        if t.pl_batch_shrink > 0 and t.batch_size % t.pl_batch_shrink:
+        if t.pl_batch_shrink < 1:
+            errs.append(f"pl_batch_shrink must be ≥ 1, got "
+                        f"{t.pl_batch_shrink} (1 = full-batch probe)")
+        elif t.batch_size % t.pl_batch_shrink:
             errs.append(f"pl_batch_shrink ({t.pl_batch_shrink}) must divide "
                         f"batch_size ({t.batch_size})")
+        if t.r1_batch_shrink < 1:
+            errs.append(f"r1_batch_shrink must be ≥ 1, got "
+                        f"{t.r1_batch_shrink}")
+        elif t.batch_size % t.r1_batch_shrink:
+            errs.append(f"r1_batch_shrink ({t.r1_batch_shrink}) must divide "
+                        f"batch_size ({t.batch_size}) — the R1 slice would "
+                        f"silently truncate")
         # Divisibility failures most likely on a pod (ADVICE r3): catch them
         # here with a clear message instead of an opaque sharding error at
         # the first device_put / a trace-time reshape failure in mbstd.

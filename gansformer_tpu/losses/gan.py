@@ -59,6 +59,27 @@ def r1_penalty(d_score: Callable[[jax.Array], jax.Array],
     return jnp.mean(per_sample)
 
 
+def r1_slice(reals: jax.Array, batch_shrink: int) -> jax.Array:
+    """The R1 batch slice of the ``r1_batch_shrink`` MFU lever (ISSUE 5).
+
+    Returns the first ``N // batch_shrink`` reals — the subset the penalty
+    is computed on when the lever is armed.  Statistical contract: the
+    reals arrive in dataset-shuffle order, so a prefix slice is an
+    exchangeable subsample and ``mean over slice`` is an unbiased
+    estimator of ``mean over batch`` — the lazy-reg weight
+    ((γ/2)·d_reg_interval) therefore stays UNCHANGED; the lever trades
+    estimator variance for the double-backward's batch dimension.
+    ``batch_shrink`` must divide N (enforced by config.validate()); the
+    caller slices any conditioning label identically.
+    """
+    assert batch_shrink >= 1
+    if batch_shrink == 1:
+        return reals
+    n = reals.shape[0]
+    assert n % batch_shrink == 0, (n, batch_shrink)
+    return reals[: n // batch_shrink]
+
+
 def path_length_penalty(
     synthesize: Callable[[jax.Array], jax.Array],
     ws: jax.Array,

@@ -62,6 +62,13 @@ class BipartiteAttention(nn.Module):
     # (fused blockwise kernels, forward-only — sampling/metric sweeps;
     # ops/pallas_attention.py).  Pallas path sows no probability maps.
     backend: str = "xla"
+    # MFU lever (ModelConfig.attn_fused_kv, ISSUE 5): one K∥V projection
+    # matmul per direction instead of two.  Exact math (concatenated
+    # weight columns — EqualDense's 1/√fan_in scale depends only on the
+    # shared input width); the duplex centroid phase then reads the
+    # n = H·W grid once instead of twice.  Different param tree — the
+    # variant owns its own checkpoints.
+    fused_kv: bool = False
 
     def _attend(self, q, k, v):
         """(out, probs|None) via the configured backend."""
@@ -137,10 +144,20 @@ class BipartiteAttention(nn.Module):
             for it in range(self.kmeans_iters):
                 q_y = EqualDense(att, dtype=self.dtype,
                                  name=f"dup{it}_q_y")(y.astype(self.dtype))
-                k_x = EqualDense(att, dtype=self.dtype,
-                                 name=f"dup{it}_k_x")(grid_qk) + pos
-                v_x = EqualDense(self.latent_dim, dtype=self.dtype,
-                                 name=f"dup{it}_v_x")(grid.astype(self.dtype))
+                if self.fused_kv:
+                    # K∥V in one matmul over the grid (v_x's unfused input
+                    # grid.astype(dtype) IS grid_qk); pos enters K only.
+                    kv_x = EqualDense(att + self.latent_dim,
+                                      dtype=self.dtype,
+                                      name=f"dup{it}_kv_x")(grid_qk)
+                    k_x = kv_x[..., :att] + pos
+                    v_x = kv_x[..., att:]
+                else:
+                    k_x = EqualDense(att, dtype=self.dtype,
+                                     name=f"dup{it}_k_x")(grid_qk) + pos
+                    v_x = EqualDense(self.latent_dim, dtype=self.dtype,
+                                     name=f"dup{it}_v_x")(
+                                         grid.astype(self.dtype))
                 upd, _ = self._attend(q_y, k_x, v_x)
                 gate = EqualDense(self.latent_dim, dtype=self.dtype,
                                   name=f"dup{it}_gate")(upd)
@@ -150,8 +167,15 @@ class BipartiteAttention(nn.Module):
 
         # Main phase: grid attends to (possibly refined) latents.
         q_x = EqualDense(att, dtype=self.dtype, name="q_x")(grid_qk) + pos
-        k_y = EqualDense(att, dtype=self.dtype, name="k_y")(y.astype(self.dtype))
-        v_y = EqualDense(att, dtype=self.dtype, name="v_y")(y.astype(self.dtype))
+        if self.fused_kv:
+            kv_y = EqualDense(2 * att, dtype=self.dtype,
+                              name="kv_y")(y.astype(self.dtype))
+            k_y, v_y = kv_y[..., :att], kv_y[..., att:]
+        else:
+            k_y = EqualDense(att, dtype=self.dtype,
+                             name="k_y")(y.astype(self.dtype))
+            v_y = EqualDense(att, dtype=self.dtype,
+                             name="v_y")(y.astype(self.dtype))
         out, probs = self._attend(q_x, k_y, v_y)
         # Region-assignment maps [N, heads, n, k] — the GANsformer paper's
         # attention visualizations; collected only when callers apply with

@@ -59,6 +59,7 @@ class Discriminator(nn.Module):
                     pos_encoding=cfg.pos_encoding,
                     grid_shard=cfg.sequence_parallel,
                     backend=cfg.attention_backend,
+                    fused_kv=cfg.attn_fused_kv,
                     dtype=dtype, name=f"b{res}_attn")(x, y)
             t = EqualConv(x.shape[-1], act="lrelu", resample_filter=f,
                           dtype=dtype, name=f"b{res}_conv0")(x)

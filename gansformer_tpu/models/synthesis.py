@@ -86,6 +86,7 @@ class SynthesisNetwork(nn.Module):
                     pos_encoding=cfg.pos_encoding,
                     grid_shard=cfg.sequence_parallel,
                     backend=cfg.attention_backend,
+                    fused_kv=cfg.attn_fused_kv,
                     dtype=dtype, name=f"b{res}_attn")(x, y)
                 if cfg.style_mode == "attention":
                     # ReZero-gated: scalar starts at 0 so styling begins

@@ -35,6 +35,7 @@ from gansformer_tpu.losses.gan import (
     g_nonsaturating_loss,
     path_length_penalty,
     r1_penalty,
+    r1_slice,
 )
 from gansformer_tpu.models.discriminator import Discriminator
 from gansformer_tpu.models.generator import Generator
@@ -171,8 +172,15 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
             "Loss/scores/fake": jnp.mean(fake_logits),
         }
         if do_r1:
+            # r1_batch_shrink lever (default 1 = full batch): the penalty
+            # rides a batch slice; the slice mean is unbiased so the
+            # lazy-reg weight below stays as-is (losses/gan.py r1_slice).
+            reals_r1 = r1_slice(reals, t.r1_batch_shrink)
+            label_r1 = (None if label is None
+                        else label[: reals_r1.shape[0]])
             r1 = r1_penalty(
-                lambda x: D.apply({"params": d_params}, x, label), reals)
+                lambda x: D.apply({"params": d_params}, x, label_r1),
+                reals_r1)
             aux["Loss/D/r1"] = r1
             # lazy reg: scale by interval so the *time-averaged* strength
             # matches an every-step penalty (reference trick).

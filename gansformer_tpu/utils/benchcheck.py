@@ -148,3 +148,37 @@ def find_suspects(
                 f"{loop_total:.2f}s timed loop — block_until_ready "
                 f"returned before the device finished (early acks)")
     return out
+
+
+def lower_phase(cfg, phase: str, batch_size: Optional[int] = None):
+    """AOT-compile ONE real step phase with abstract args — the shared
+    lowering every measurement surface uses (bench_components'
+    share-of-step denominator, ab_levers' per-variant cost pass,
+    readiness_ffhq1024's memory_analysis, the lever acceptance tests).
+
+    Handles the conditional-label arg (a labeled config's D head raises
+    at trace time without it) in exactly one place.  Imports lazily so
+    this module's pure validation half stays importable without jax.
+    Returns the compiled executable (cost_analysis / memory_analysis /
+    direct calls all hang off it).
+    """
+    import jax
+    import numpy as np
+
+    from gansformer_tpu.train.state import create_train_state
+    from gansformer_tpu.train.steps import make_train_steps
+
+    b = batch_size if batch_size is not None else cfg.train.batch_size
+    fns = make_train_steps(cfg, batch_size=b)
+    fn = {"d": fns.d_step, "d_r1": fns.d_step_r1,
+          "g": fns.g_step, "g_pl": fns.g_step_pl}[phase]
+    key_s = jax.ShapeDtypeStruct((2,), np.uint32)
+    state_s = jax.eval_shape(lambda k: create_train_state(cfg, k), key_s)
+    imgs_s = jax.ShapeDtypeStruct(
+        (b, cfg.model.resolution, cfg.model.resolution,
+         cfg.model.img_channels), np.uint8)
+    lbl_s = (jax.ShapeDtypeStruct((b, cfg.model.label_dim), np.float32)
+             if cfg.model.label_dim else None)
+    args = ((state_s, imgs_s, key_s, lbl_s) if phase.startswith("d")
+            else (state_s, key_s, lbl_s))
+    return fn.lower(*args).compile()

@@ -1,0 +1,241 @@
+"""A/B battery for the flag-gated step-time levers (ISSUE 5, PERF.md §1d).
+
+Each lever is a prepared, config-flag-gated variant of one train-step
+phase.  This script prices every variant against its baseline with the
+same methodology bench.py applies to the phases: AOT-compile the REAL
+jitted step program per variant, read ``cost_analysis()`` FLOPs + bytes
+and ``memory_analysis()`` temp workspace, and — on a TPU — time the
+steady-state step and report the measured Δms.  On CPU the structure
+(FLOPs/bytes/workspace deltas) is exact and timings are skipped, so the
+same artifact schema works for the offline cost-delta table in PERF.md
+and for the on-chip decision table a tunnel window produces.
+
+  python scripts/ab_levers.py [--preset ffhq256-duplex] [--batch 8] \
+      [--iters 10] [--json-out ab_levers.json] [--levers pl_batch_shrink]
+  python scripts/ab_levers.py --config run_dir/config.json   # custom cfg
+
+Lever catalog (wired through core/config.py + cli/train.py; acceptance
+contracts in tests/test_levers.py):
+
+  pl_batch_shrink   g_pl phase — PL probe on batch/N fresh samples
+                    (StyleGAN2's own trick; 2 is the reference default)
+  r1_batch_shrink   d_r1 phase — R1 on an unbiased batch slice,
+                    lazy-reg weight unchanged (default 1 = off)
+  attn_fused_kv     every phase — one K∥V projection matmul per
+                    attention direction (exact math, default off)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train_cfg(cfg, **kv):
+    return dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, **kv))
+
+
+def _model_cfg(cfg, **kv):
+    return dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, **kv))
+
+
+# Lever catalog: name → (phase, CLI flag, test anchor, variants).  Each
+# variant is (setting_label, cfg_transform); the entry tagged
+# ``baseline`` is the Δ reference.
+def lever_catalog():
+    return [
+        {
+            "name": "pl_batch_shrink",
+            "phase": "g_pl",
+            "flag": "--pl-batch-shrink (TrainConfig.pl_batch_shrink)",
+            "test": "tests/test_levers.py::TestPlBatchShrink",
+            "baseline": "2",
+            "variants": [
+                ("1", lambda c: _train_cfg(c, pl_batch_shrink=1)),
+                ("2", lambda c: _train_cfg(c, pl_batch_shrink=2)),
+                ("4", lambda c: _train_cfg(c, pl_batch_shrink=4)),
+            ],
+        },
+        {
+            "name": "r1_batch_shrink",
+            "phase": "d_r1",
+            "flag": "--r1-batch-shrink (TrainConfig.r1_batch_shrink)",
+            "test": "tests/test_levers.py::TestR1BatchShrink",
+            "baseline": "1",
+            "variants": [
+                ("1", lambda c: _train_cfg(c, r1_batch_shrink=1)),
+                ("2", lambda c: _train_cfg(c, r1_batch_shrink=2)),
+                ("4", lambda c: _train_cfg(c, r1_batch_shrink=4)),
+            ],
+        },
+        {
+            "name": "attn_fused_kv",
+            "phase": "g",
+            "flag": "--attn-fused-kv (ModelConfig.attn_fused_kv)",
+            "test": "tests/test_levers.py::test_attn_fused_kv_parity",
+            "baseline": "off",
+            "variants": [
+                ("off", lambda c: _model_cfg(c, attn_fused_kv=False)),
+                ("on", lambda c: _model_cfg(c, attn_fused_kv=True)),
+            ],
+        },
+    ]
+
+
+def attach_deltas(lever: dict) -> dict:
+    """Fill delta_* fields vs the lever's baseline variant (pure —
+    unit-tested): Δ < 0 means the variant is cheaper."""
+    base = next((v for v in lever["variants"]
+                 if v["setting"] == lever["baseline"]), None)
+    for v in lever["variants"]:
+        v["is_baseline"] = base is not None and v is base
+        for key in ("gflops", "gbytes", "temp_gib", "ms"):
+            if base and v.get(key) is not None and base.get(key) is not None:
+                v[f"delta_{key}"] = round(v[key] - base[key], 4)
+    return lever
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="ffhq256-duplex")
+    p.add_argument("--config", default=None,
+                   help="JSON config file overriding --preset (a run "
+                        "dir's config.json or a test's micro config)")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--json-out", default=None)
+    p.add_argument("--levers", default=None,
+                   help="comma list restricting which levers run")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
+
+    enable_compile_cache(_REPO)
+
+    import numpy as np
+
+    from gansformer_tpu.core.config import ExperimentConfig, get_preset
+    from gansformer_tpu.train.state import create_train_state
+    from gansformer_tpu.utils.benchcheck import (
+        flops_of, lower_phase, peak_tflops)
+
+    if args.config:
+        with open(args.config) as f:
+            base_cfg = ExperimentConfig.from_json(f.read())
+    else:
+        base_cfg = get_preset(args.preset)
+    b = args.batch
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    peak = peak_tflops(dev.device_kind) if on_tpu else None
+    rs = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+    imgs_np = rs.randint(
+        0, 255, (b, base_cfg.model.resolution, base_cfg.model.resolution,
+                 base_cfg.model.img_channels)).astype(np.uint8)
+
+    meta = {"device_kind": dev.device_kind, "platform": dev.platform,
+            "batch": b, "preset": base_cfg.name,
+            "peak_bf16_tflops": peak, "iters": args.iters}
+    print(json.dumps(meta), flush=True)
+
+    def measure(cfg, phase):
+        """(gflops, gbytes, temp_gib, ms|None) of one phase program."""
+        cfg.validate()
+        label_dim = cfg.model.label_dim
+        # Shared lowering (benchcheck.lower_phase): abstract state via
+        # eval_shape + the conditional-label arg in one place.
+        compiled = lower_phase(cfg, phase, batch_size=b)
+        fl = flops_of(compiled)
+        rec = {"gflops": round(fl / 1e9, 2) if fl else None}
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            by = float(ca.get("bytes accessed", 0.0))
+            rec["gbytes"] = round(by / 1e9, 3) if by > 0 else None
+        except Exception:
+            rec["gbytes"] = None
+        try:
+            ma = compiled.memory_analysis()
+            rec["temp_gib"] = round(ma.temp_size_in_bytes / 2**30, 3)
+        except Exception:
+            rec["temp_gib"] = None
+        rec["ms"] = None
+        if on_tpu:
+            # Real steady-state timing: whole-state init as ONE jitted
+            # program (the eager path dispatches hundreds of tunnel
+            # round-trips — PERF.md §1c harness note).  The timing loop
+            # drives the AOT ``compiled`` executable from the cost pass
+            # above — calling the jit wrapper here would pay a SECOND
+            # compile of the same program into the window budget
+            # (bench.py's established pattern).
+            state = jax.jit(lambda k: create_train_state(cfg, k))(key)
+            imgs = jax.device_put(imgs_np)
+            lbl = (jax.device_put(np.eye(label_dim, dtype=np.float32)[
+                rs.randint(0, label_dim, b)]) if label_dim else None)
+            call = ((lambda s: compiled(s, imgs, key, lbl))
+                    if phase.startswith("d")
+                    else (lambda s: compiled(s, key, lbl)))
+            state, aux = call(state)             # warm-up (donates state)
+            jax.block_until_ready(aux)
+            t0 = time.time()
+            for _ in range(args.iters):
+                state, aux = call(state)
+            jax.block_until_ready(aux)
+            rec["ms"] = round((time.time() - t0) / args.iters * 1e3, 3)
+            if fl and peak:
+                rec["mfu"] = round(
+                    fl / (rec["ms"] * 1e-3) / (peak * 1e12), 4)
+        return rec
+
+    selected = None if args.levers is None else {
+        s.strip() for s in args.levers.split(",") if s.strip()}
+    levers = []
+    for lever in lever_catalog():
+        if selected is not None and lever["name"] not in selected:
+            continue
+        out = {k: lever[k] for k in
+               ("name", "phase", "flag", "test", "baseline")}
+        out["variants"] = []
+        for setting, transform in lever["variants"]:
+            cfg = _train_cfg(transform(base_cfg), batch_size=b)
+            t0 = time.time()
+            try:
+                rec = measure(cfg, lever["phase"])
+            except Exception as e:   # an OOM/compile failure on one
+                rec = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            rec = {"setting": setting, **rec,
+                   "measure_s": round(time.time() - t0, 1)}
+            print(json.dumps({"lever": lever["name"], **rec}), flush=True)
+            out["variants"].append(rec)
+        levers.append(attach_deltas(out))
+
+    artifact = {"meta": meta, "levers": levers,
+                "note": ("CPU run: FLOPs/bytes/workspace deltas are "
+                         "exact, ms is null — only a TPU window prices "
+                         "time" if not on_tpu else None)}
+    if args.json_out:
+        tmp = args.json_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f, indent=1)
+        os.replace(tmp, args.json_out)
+    print(json.dumps({"ab_levers_done": [lv["name"] for lv in levers]}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,295 @@
+"""Stage-completion ledger + battery runner for the TPU tunnel windows.
+
+The tunnel serves minutes-long windows separated by hours of outage
+(PERF.md §1c availability tally), and r5's single-shot battery burned the
+round's only window on the first partial claim (VERDICT r5 item 1 /
+weak #2).  This module makes the battery MULTI-WINDOW and RESUMABLE:
+
+* Every window gets its own ``<out>/window_<ts>/`` directory with a
+  ``done.json`` ledger mapping stage name → {exit, duration_s, artifact}.
+  The ledger is appended atomically after EACH stage, so a window that
+  dies mid-battery (tunnel drop, kill, power) keeps every completed
+  stage's record.
+* ``completed_stages()`` is the union of successful stages over ALL
+  windows; ``run_battery()`` fires only the missing ones — the next
+  window resumes where the last one died instead of repeating the head.
+* After a stage fails, the (cheap) backend probe runs between stages:
+  a dead tunnel aborts the window immediately instead of burning the
+  remaining budgets against a wedged claim loop.
+
+Stage order is most-important-first (VERDICT r5 item 1): the four-phase +
+fused-cycle bench JSON (no sweep, 600 s inner budget) lands within the
+first ~10 minutes of the FIRST window; the attribution + lever A/B stages
+follow so one window converts into a measured decision table (PERF.md
+§1d); the sweep/pallas/train stages ride later windows if needed.
+
+  python scripts/battery.py run    [--out .probe]     # exit 0=complete, 3=partial
+  python scripts/battery.py status [--out .probe]     # same exits, no side effects
+
+``scripts/probe_and_bench.sh`` is the minute-0 loop around this: probe
+every PROBE_INTERVAL, re-fire on every successful claim until the ledger
+says complete.  ``GRAFT_PROBE_CMD`` overrides the backend probe (tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_TIMEOUT_S = 120
+MARKER = "BATTERY_RUNNING"
+
+
+def stage(name, budget_s, artifact, argv, env=None, copies=()):
+    return {"name": name, "budget_s": budget_s, "artifact": artifact,
+            "argv": list(argv), "env": dict(env or {}),
+            "copies": list(copies)}
+
+
+def default_stages():
+    py = sys.executable
+    return [
+        # 1. Four phases + fused cycle, NO sweep: the round's headline
+        #    numbers inside ~10 minutes (inner budget 600 s; bench.py
+        #    emits a partial JSON line as soon as the (D, G) pair times).
+        stage("bench_phases", 780, "bench_tpu.json", [py, "bench.py"],
+              env={"GRAFT_BENCH_TPU_TIMEOUT": "600",
+                   "GRAFT_BENCH_SWEEP": ""},
+              copies=[(".bench_phases.json", "bench_phases_tpu.json")]),
+        # 2. Per-op cost attribution (profiler substitute — the tracer
+        #    wedges the tunnel, PERF.md §1c).
+        stage("components", 900, "components_tpu.jsonl",
+              [py, "scripts/bench_components.py",
+               "--json-out", "{win}/components_attribution.json"]),
+        # 3. Flag-gated lever A/B — the measured decision table.
+        stage("ab_levers", 1500, "ab_levers_tpu.jsonl",
+              [py, "scripts/ab_levers.py",
+               "--json-out", "{win}/ab_levers_tpu.json"]),
+        # 4. ffhq1024 memory readiness (VERDICT r5 item 5).
+        stage("readiness_1024", 900, "readiness_1024_tpu.jsonl",
+              [py, "scripts/readiness_ffhq1024.py",
+               "--json-out", "{win}/readiness_1024_tpu.json"]),
+        # 5. Batch sweep (the optional throughput upside).
+        stage("bench_sweep", 1800, "bench_sweep_tpu.json", [py, "bench.py"],
+              env={"GRAFT_BENCH_TPU_TIMEOUT": "1500",
+                   "GRAFT_BENCH_SWEEP": "16,32"}),
+        # 6. Native-kernel record (Mosaic compile + parity).
+        stage("pallas", 600, "pallas_tpu.json",
+              [py, "scripts/bench_pallas_attention.py"]),
+        # 7. Real loop on the chip; stats.jsonl carries timing/mfu.
+        stage("train_ticks", 1200, None,
+              [py, "-m", "gansformer_tpu.cli.train",
+               "--preset", "ffhq256-duplex", "--data-source", "synthetic",
+               "--batch-size", "8", "--total-kimg", "8", "--fused-cycle",
+               "--results-dir", "{win}/train_tpu"]),
+    ]
+
+
+def default_probe_argv():
+    override = os.environ.get("GRAFT_PROBE_CMD")
+    if override:
+        return ["sh", "-c", override]
+    # PYTHONPATH stays ambient: the axon sitecustomize IS the TPU plugin.
+    return [sys.executable, "-c",
+            "import jax; d = jax.devices(); "
+            "assert d[0].platform == 'tpu', d; print(d[0].device_kind)"]
+
+
+def probe_ok(probe_argv=None, timeout=PROBE_TIMEOUT_S) -> bool:
+    try:
+        return subprocess.run(default_probe_argv()
+                              if probe_argv is None else probe_argv,
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL,
+                              timeout=timeout).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+# --- ledger ------------------------------------------------------------
+
+
+def window_dirs(root):
+    if not os.path.isdir(root):
+        return []
+    return sorted(os.path.join(root, d) for d in os.listdir(root)
+                  if d.startswith("window_")
+                  and os.path.isdir(os.path.join(root, d)))
+
+
+def load_done(win) -> dict:
+    path = os.path.join(win, "done.json")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return {}   # torn write: treat the window's ledger as empty
+
+
+def append_done(win, name, record) -> None:
+    """Atomic read-modify-replace so a kill between stages never corrupts
+    the records of the stages that DID complete."""
+    done = load_done(win)
+    done[name] = record
+    tmp = os.path.join(win, "done.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(done, f, indent=1, sort_keys=True)
+    os.replace(tmp, os.path.join(win, "done.json"))
+
+
+def completed_stages(root) -> dict:
+    """stage name → its successful record, unioned over every window
+    (later windows win).  Only exit==0 counts as done — a timeout or
+    crash leaves the stage missing, so the next window re-fires it."""
+    out = {}
+    for win in window_dirs(root):
+        for name, rec in load_done(win).items():
+            if rec.get("exit") == 0:
+                out[name] = {**rec, "window": os.path.basename(win)}
+    return out
+
+
+# --- running -----------------------------------------------------------
+
+
+def _utcnow():
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def new_window(root) -> str:
+    base = os.path.join(root, "window_" +
+                        _utcnow().strftime("%Y%m%dT%H%M%SZ"))
+    win, i = base, 0
+    while os.path.exists(win):        # same-second re-arm (tests)
+        i += 1
+        win = f"{base}_{i}"
+    os.makedirs(win)
+    return win
+
+
+def run_stage(win, st, log) -> dict:
+    argv = [a.replace("{win}", win) for a in st["argv"]]
+    env = {**os.environ, **st["env"]}
+    log(f"stage start: {st['name']} (budget {st['budget_s']}s): "
+        f"{' '.join(argv)}")
+    art_path = (os.path.join(win, st["artifact"]) if st["artifact"]
+                else None)
+    log_path = os.path.join(win, "battery.log")
+    t0 = time.time()
+    try:
+        with open(log_path, "a") as lf:
+            if art_path:
+                with open(art_path, "w") as af:
+                    r = subprocess.run(argv, stdout=af, stderr=lf,
+                                       cwd=_REPO, env=env,
+                                       timeout=st["budget_s"])
+            else:
+                r = subprocess.run(argv, stdout=lf, stderr=lf,
+                                   cwd=_REPO, env=env,
+                                   timeout=st["budget_s"])
+        exit_code = r.returncode
+    except subprocess.TimeoutExpired:
+        exit_code = "timeout"
+    except OSError as e:
+        exit_code = f"oserror: {e}"
+    rec = {"exit": exit_code, "duration_s": round(time.time() - t0, 1),
+           "artifact": st["artifact"],
+           "completed_at": _utcnow().strftime("%Y-%m-%dT%H:%M:%SZ")}
+    # Side-artifact copies run even on failure/timeout: bench.py emits
+    # .bench_phases.json INCREMENTALLY, and a timed-out window's partial
+    # numbers must be preserved before the next window's re-fire
+    # overwrites the repo-root file (the pre-ledger script copied
+    # unconditionally too).
+    for src, dst in st["copies"]:
+        sp = os.path.join(_REPO, src)
+        if os.path.exists(sp):
+            shutil.copy(sp, os.path.join(win, dst))
+    log(f"stage exit={exit_code}: {st['name']} "
+        f"({rec['duration_s']}s)")
+    return rec
+
+
+def run_battery(root, stages=None, probe_argv=None, reprobe=True,
+                log=None) -> dict:
+    """Fire every stage not yet completed in ANY window into a fresh
+    window dir.  Returns {window, ran, failed, remaining, complete,
+    aborted}; ``complete`` means the whole battery is done across all
+    windows (the caller's probe loop can stop)."""
+    stages = default_stages() if stages is None else stages
+    log = log or (lambda msg: print(f"[battery] {msg}", flush=True))
+    os.makedirs(root, exist_ok=True)
+    done = completed_stages(root)
+    missing = [s for s in stages if s["name"] not in done]
+    if not missing:
+        return {"window": None, "ran": [], "failed": [], "remaining": [],
+                "complete": True, "aborted": False}
+    win = new_window(root)
+    log(f"window {os.path.basename(win)}: {len(missing)} missing "
+        f"stage(s): {[s['name'] for s in missing]}")
+    marker = os.path.join(root, MARKER)
+    with open(marker, "w") as f:
+        f.write(os.path.basename(win) + "\n")
+    ran, failed, aborted = [], [], False
+    try:
+        for i, st in enumerate(missing):
+            rec = run_stage(win, st, log)
+            append_done(win, st["name"], rec)
+            (ran if rec["exit"] == 0 else failed).append(st["name"])
+            if rec["exit"] != 0 and reprobe and i + 1 < len(missing):
+                # Don't burn the remaining budgets against a dead
+                # tunnel: cheap re-probe decides abort-vs-continue.
+                if not probe_ok(probe_argv):
+                    log("window dead (stage failed AND re-probe failed); "
+                        "aborting — remaining stages re-fire next window")
+                    aborted = True
+                    break
+    finally:
+        try:
+            os.remove(marker)
+        except OSError:
+            pass
+    done = completed_stages(root)
+    remaining = [s["name"] for s in stages if s["name"] not in done]
+    result = {"window": win, "ran": ran, "failed": failed,
+              "remaining": remaining, "complete": not remaining,
+              "aborted": aborted}
+    log(f"battery {'complete' if result['complete'] else 'partial'}: "
+        f"ran={ran} failed={failed} remaining={remaining}")
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("cmd", nargs="?", default="run",
+                   choices=("run", "status"))
+    p.add_argument("--out", default=os.path.join(_REPO, ".probe"))
+    p.add_argument("--no-reprobe", action="store_true",
+                   help="don't probe the backend between failed stages")
+    args = p.parse_args(argv)
+    if args.cmd == "status":
+        done = completed_stages(args.out)
+        names = [s["name"] for s in default_stages()]
+        out = {"completed": sorted(done),
+               "remaining": [n for n in names if n not in done],
+               "windows": [os.path.basename(w)
+                           for w in window_dirs(args.out)]}
+        print(json.dumps(out, indent=1))
+        return 0 if not out["remaining"] else 3
+    res = run_battery(args.out, reprobe=not args.no_reprobe)
+    print(json.dumps({k: v for k, v in res.items() if k != "window"}
+                     | {"window": os.path.basename(res["window"])
+                        if res["window"] else None}))
+    return 0 if res["complete"] else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

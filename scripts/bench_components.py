@@ -1,27 +1,42 @@
-"""Component-level TPU micro-bench: the "poor man's profiler" for the tunnel.
+"""Component-level cost attribution: the "poor man's profiler" for the tunnel.
 
 ``jax.profiler`` cannot run over the axon TPU tunnel (observed r4: the
 tracer hangs AND a client killed mid-trace wedges the backend claim for
 subsequent processes — see bench.py ``run_witness``), so per-op time
-attribution comes from here instead: each major sub-program of the flagship
-ffhq256-duplex step is compiled and timed as its own jitted program, with
-XLA cost-analysis FLOPs and the chip's bf16 peak giving a per-component
-MFU.  A component whose MFU sits far below the full-step average is the
-optimization target; one far above average is already MXU-bound.
+attribution comes from here instead.  Each major sub-program of the
+flagship ffhq256-duplex step is AOT-compiled as its own jitted program and
+read through XLA ``cost_analysis()`` (FLOPs + bytes accessed); on a TPU it
+is also self-timed, giving a per-component MFU.  A component whose MFU
+sits far below the full-step average is the optimization target; one far
+above average is already MXU-bound.
 
-Prints one JSON line per component: {name, ms, gflops, mfu, shapes}.
+The component set covers the four phases' expected time sinks (ISSUE 5 /
+PERF.md §1c top-3): G's modulated up-convs at the 128²/256² grids (forward
+AND first-order backward), the PL double-backward through synthesis (the
+largest phase's defining cost), D's fromRGB + first two residual blocks,
+and the bipartite-attention einsums (block-level and raw).
 
-  python scripts/bench_components.py [--iters 30] [--batch 8]
+Output: one JSON line per component on stdout (incremental — a dying
+tunnel window still yields the lines that ran), plus ``--json-out`` with
+the full artifact INCLUDING the ranked attribution table
+``{component → GFLOPs → expected ms @ the assumed MFU → share of step}``.
+On CPU the structure (FLOPs/bytes/shares/ranking) is exact and the
+timings are meaningless; on TPU the measured ms replaces the projection.
+
+  python scripts/bench_components.py [--iters 30] [--batch 8] \
+      [--preset ffhq256-duplex] [--json-out artifact.json] [--skip-phases]
 
 Caveats: isolated-program MFU is not additive to the step MFU (XLA fuses
 across component boundaries inside the real step, and backward passes are
-timed as grad-of-component here), but the RANKING of time sinks transfers.
+timed as grad-of-component here), so ``share_of_step`` values overlap and
+do NOT sum to 1 — the RANKING of time sinks is what transfers.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -30,13 +45,89 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Default "current MFU" for the expected-ms projection: the one
+# physics-valid hardware datapoint (PERF.md §1c — d phase at 33% on the
+# v5e).  Overridable; the artifact records what was used.
+ASSUMED_MFU = 0.33
+# Projection peak when not on a TPU (PERF.md §1b: the v5e target chip).
+DEFAULT_PEAK_TFLOPS = 197.0
 
-def main() -> None:
+
+def expected_ms(flops: float, peak_tflops: float, mfu: float) -> float:
+    """Time a program of ``flops`` would take at ``mfu`` of ``peak``."""
+    return flops / (mfu * peak_tflops * 1e12) * 1e3
+
+
+def build_attribution(components, step_flops, peak_tflops, assumed_mfu,
+                      on_tpu):
+    """Ranked per-component attribution table (pure — unit-tested).
+
+    ``components``: list of dicts with at least ``name`` and optionally
+    ``gflops`` / ``gbytes`` / ``ms`` (measured).  Rank key: measured ms on
+    TPU (the ground truth), cost-model FLOPs otherwise.  ``share_of_step``
+    is component FLOPs over the cadence-weighted per-iteration step FLOPs
+    (None when phases were skipped); shares OVERLAP (a backward component
+    contains its forward) — they rank, they do not partition.
+    """
+    rows = []
+    for c in components:
+        fl = c.get("gflops")
+        row = {"name": c["name"],
+               "gflops": fl,
+               "gbytes": c.get("gbytes"),
+               "ms_measured": c.get("ms") if on_tpu else None,
+               "mfu_measured": c.get("mfu") if on_tpu else None,
+               "expected_ms": (
+                   round(expected_ms(fl * 1e9, peak_tflops, assumed_mfu), 3)
+                   if fl else None),
+               "share_of_step": (
+                   round(fl * 1e9 / step_flops, 4)
+                   if fl and step_flops else None)}
+        rows.append(row)
+    def key(r):
+        if on_tpu and r["ms_measured"] is not None:
+            return r["ms_measured"]
+        return r["expected_ms"] or 0.0
+    rows.sort(key=key, reverse=True)
+    for rank, r in enumerate(rows):
+        r["rank"] = rank + 1
+    return rows
+
+
+def phase_flops(cfg, batch):
+    """Per-phase cost-analysis FLOPs of the four REAL step programs +
+    the cadence-weighted per-iteration total (PERF.md §1b methodology;
+    unsharded lowering — cost analysis is per-device under SPMD anyway;
+    conditional-label handling lives in the shared ``lower_phase``)."""
+    from gansformer_tpu.utils.benchcheck import (
+        cadence_weighted, flops_of, lower_phase)
+
+    ph = {}
+    for name in ("d", "g", "d_r1", "g_pl"):
+        fl = flops_of(lower_phase(cfg, name, batch_size=batch))
+        if fl:
+            ph[name] = fl
+    if not all(k in ph for k in ("d", "g", "d_r1", "g_pl")):
+        return ph, None
+    t = cfg.train
+    return ph, cadence_weighted(ph, t.d_reg_interval, t.g_reg_interval)
+
+
+def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--preset", default="ffhq256-duplex")
-    args = p.parse_args()
+    p.add_argument("--json-out", default=None,
+                   help="write the full artifact (components + ranked "
+                        "attribution table) here")
+    p.add_argument("--skip-phases", action="store_true",
+                   help="skip lowering the four real step programs (the "
+                        "share-of-step denominator) — faster, shares null")
+    p.add_argument("--assumed-mfu", type=float, default=ASSUMED_MFU)
+    p.add_argument("--peak-tflops", type=float, default=None,
+                   help="projection peak off-TPU (default: v5e 197)")
+    args = p.parse_args(argv)
 
     import jax
 
@@ -48,49 +139,76 @@ def main() -> None:
     import numpy as np
 
     from gansformer_tpu.core.config import get_preset
+    from gansformer_tpu.losses.gan import path_length_penalty
+    from gansformer_tpu.models.attention import BipartiteAttention
     from gansformer_tpu.models.discriminator import Discriminator
     from gansformer_tpu.models.generator import Generator
+    from gansformer_tpu.models.layers import EqualConv
+    from gansformer_tpu.ops.attention import multihead_attention
     from gansformer_tpu.ops.modulated_conv import (
         _conv, conv2d, modulated_conv2d)
     from gansformer_tpu.ops.upfirdn2d import downsample_2d, upsample_2d
-    from gansformer_tpu.utils.benchcheck import peak_tflops
+    from gansformer_tpu.utils.benchcheck import flops_of, peak_tflops
 
-    cfg = get_preset(args.preset).model
+    full_cfg = get_preset(args.preset)
+    cfg = full_cfg.model
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     peak = peak_tflops(dev.device_kind) if on_tpu else None
+    proj_peak = peak or args.peak_tflops or DEFAULT_PEAK_TFLOPS
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     b = args.batch
     rs = np.random.RandomState(0)
     key = jax.random.PRNGKey(0)
+    components: list = []
 
-    print(json.dumps({"device_kind": dev.device_kind,
-                      "platform": dev.platform, "batch": b,
-                      "preset": args.preset,
-                      "peak_bf16_tflops": peak}), flush=True)
+    meta = {"device_kind": dev.device_kind, "platform": dev.platform,
+            "batch": b, "preset": args.preset, "peak_bf16_tflops": peak,
+            "projection_peak_tflops": proj_peak,
+            "assumed_mfu": args.assumed_mfu}
+    print(json.dumps(meta), flush=True)
 
-    from gansformer_tpu.utils.benchcheck import flops_of
+    def bytes_of(compiled):
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            v = float(ca.get("bytes accessed", 0.0))
+            return v if v > 0 else None
+        except Exception:
+            return None
 
     def timed(name: str, fn, *xs, **extra_info):
-        """Compile fn(*xs), time it, emit one JSON line."""
+        """Compile fn(*xs), time it (TPU only), emit one JSON line,
+        record it.  Off-TPU the timing loop is skipped entirely — the
+        artifact nulls CPU timings anyway, and executing e.g. the PL
+        double-backward 30× on the host would waste minutes per
+        component for numbers nobody reads."""
         t0 = time.time()
         compiled = jax.jit(fn).lower(*xs).compile()
         c_s = time.time() - t0
         fl = flops_of(compiled)
-        out = compiled(*xs)
-        jax.block_until_ready(out)          # warm-up
-        t0 = time.time()
-        for _ in range(args.iters):
-            out = compiled(*xs)
+        by = bytes_of(compiled)
+        out = compiled(*xs)        # one execution: some outputs chain on
         jax.block_until_ready(out)
-        ms = (time.time() - t0) / args.iters * 1e3
-        line = {"name": name, "ms": round(ms, 3), "compile_s": round(c_s, 1)}
+        line = {"name": name, "compile_s": round(c_s, 1)}
+        ms = None
+        if on_tpu:
+            t0 = time.time()
+            for _ in range(args.iters):
+                out = compiled(*xs)
+            jax.block_until_ready(out)
+            ms = (time.time() - t0) / args.iters * 1e3
+            line["ms"] = round(ms, 3)
         if fl:
             line["gflops"] = round(fl / 1e9, 2)
-            if peak:
+            if peak and ms:
                 line["mfu"] = round(fl / (ms * 1e-3) / (peak * 1e12), 4)
+        if by:
+            line["gbytes"] = round(by / 1e9, 3)
         line.update(extra_info)
         print(json.dumps(line), flush=True)
+        components.append(line)
         return out
 
     # ---- leaf ops at each synthesis resolution ------------------------
@@ -104,6 +222,17 @@ def main() -> None:
         timed(f"modconv3x3_up2_{res}",
               lambda x, w, s: modulated_conv2d(x, w, s, up=2),
               x, w3, styles, res=res, cin=c, cout=c)
+        if res * 2 in (cfg.resolution, cfg.resolution // 2):
+            # First-order backward of the up-conv feeding the 128²/256²
+            # grids — the grad-path share of the G time sink (ISSUE 5).
+            def upconv_loss(x, w, s):
+                y = modulated_conv2d(x, w, s, up=2)
+                return jnp.mean(jnp.square(y.astype(jnp.float32)))
+
+            timed(f"modconv3x3_up2_vjp_{res}",
+                  lambda x, w, s: jax.grad(upconv_loss, argnums=(0, 1, 2))(
+                      x, w, s),
+                  x, w3, styles, res=res, cin=c, cout=c)
         # The pre-polyphase dense-at-2H formulation, timed for the on-chip
         # before/after comparison (PERF.md §1b''').
         timed(f"upconv_dense_{res}",
@@ -133,6 +262,34 @@ def main() -> None:
         timed(f"skip_down_dense_{res}", skip_dense,
               x, w1, res=res, cin=c, cout=c_out)
 
+    # ---- attention: block-level + raw einsums -------------------------
+    # The largest attention grid is where the O(n·k) einsums earn their
+    # keep (n = 16384 at attn_max_res 128); fp32 by design (PERF §1b'').
+    attn_resolutions = cfg.attn_resolutions()
+    for res in [r for r in attn_resolutions
+                if r >= (max(attn_resolutions) // 2 if attn_resolutions
+                         else 0)]:
+        nf = cfg.nf(res)
+        xg = jnp.asarray(rs.randn(b, res, res, nf), dtype)
+        yl = jnp.asarray(rs.randn(b, cfg.components, cfg.w_dim), dtype)
+        attn = BipartiteAttention(
+            grid_dim=nf, latent_dim=cfg.w_dim, num_heads=cfg.num_heads,
+            duplex=(cfg.attention == "duplex"), integration=cfg.integration,
+            kmeans_iters=cfg.kmeans_iters, pos_encoding=cfg.pos_encoding,
+            fused_kv=cfg.attn_fused_kv, dtype=dtype)
+        av = jax.jit(attn.init)(jax.random.fold_in(key, res), xg, yl)
+        timed(f"attn_block_{res}",
+              lambda v, x, y: attn.apply(v, x, y)[0], av, xg, yl,
+              res=res, n=res * res, k=cfg.components)
+        q = jnp.asarray(rs.randn(b, res * res, nf), jnp.float32)
+        kv_len = cfg.components + (1 if cfg.use_global else 0)
+        kk = jnp.asarray(rs.randn(b, kv_len, nf), jnp.float32)
+        vv = jnp.asarray(rs.randn(b, kv_len, nf), jnp.float32)
+        timed(f"attn_einsums_{res}",
+              lambda q, k, v: multihead_attention(q, k, v,
+                                                  cfg.num_heads)[0],
+              q, kk, vv, res=res, n=res * res, k=kv_len)
+
     # ---- model-level programs ----------------------------------------
     G, D = Generator(cfg), Discriminator(cfg)
     z = jnp.asarray(rs.randn(b, cfg.num_ws, cfg.latent_dim), jnp.float32)
@@ -155,8 +312,69 @@ def main() -> None:
     timed("g_fwd", lambda v, z: G.apply(v, z, rngs=noise), g_vars, z)
     timed("d_fwd", lambda v, x: D.apply(v, x), d_vars, imgs)
 
+    # ---- D front: fromRGB + first two residual blocks -----------------
+    # PERF §1c sink #3 as its own program, applied with D's real param
+    # subtrees (mirrors models/discriminator.py's block structure).
+    R = cfg.resolution
+    fblur = cfg.blur_filter
+
+    def d_front(p, img):
+        x = img.astype(dtype)
+        x = EqualConv(cfg.nf(R), kernel=1, act="lrelu",
+                      dtype=dtype).apply({"params": p["from_rgb"]}, x)
+        for res in (R, R // 2):
+            nf_out = cfg.nf(res // 2)
+            t = EqualConv(x.shape[-1], act="lrelu", resample_filter=fblur,
+                          dtype=dtype).apply(
+                              {"params": p[f"b{res}_conv0"]}, x)
+            t = EqualConv(nf_out, down=2, act="lrelu",
+                          resample_filter=fblur, dtype=dtype).apply(
+                              {"params": p[f"b{res}_conv1"]}, t)
+            skip = EqualConv(nf_out, kernel=1, down=2, use_bias=False,
+                             resample_filter=fblur, dtype=dtype).apply(
+                                 {"params": p[f"b{res}_skip"]}, x)
+            x = (t + skip) * (1.0 / math.sqrt(2.0))
+        return x
+
+    d_params = d_vars["params"]
+    timed(f"d_front_{R}", d_front, d_params, imgs, res=R)
+
+    def d_front_loss(p, img):
+        return jnp.mean(jnp.square(d_front(p, img).astype(jnp.float32)))
+
+    timed(f"d_front_fwd_bwd_{R}",
+          lambda p, x: jax.grad(d_front_loss)(p, x), d_params, imgs, res=R)
+
+    # ---- PL double-backward through synthesis -------------------------
+    # The defining cost of the largest phase (g_pl, PERF §1c sink #2):
+    # grad w.r.t. G's params of the path-length penalty, which itself
+    # contains a grad-through-synthesis — a real second-order program at
+    # the PL probe batch (batch // pl_batch_shrink, the armed lever value).
+    t_cfg = full_cfg.train
+    pl_b = max(1, b // max(1, t_cfg.pl_batch_shrink))
+    # z_pl comes from the same numpy stream as every other bench input;
+    # the jax keys only drive the probe noise and the synthesis rng.
+    k_plnoise, k_plsynth = jax.random.split(jax.random.fold_in(key, 3))
+    z_pl = jnp.asarray(
+        rs.randn(pl_b, cfg.num_ws, cfg.latent_dim), jnp.float32)
+    ws_pl = jax.jit(lambda v, z: G.apply(v, z, method=Generator.map))(
+        g_vars, z_pl)
+
+    def pl_loss(v, w, k):
+        def synth(w_):
+            return G.apply(v, w_, rngs={"noise": k_plsynth},
+                           method=Generator.synthesize)
+
+        pl, _ = path_length_penalty(synth, w, jnp.zeros(()), k)
+        return pl
+
+    timed("pl_double_backward",
+          lambda v, w, k: jax.grad(pl_loss)(v, w, k),
+          g_vars, ws_pl, k_plnoise, pl_batch=pl_b)
+
     # backward passes (first-order only — the reg phases' second-order
-    # structure is covered by bench.py's d_r1/g_pl phase numbers)
+    # structure is covered by pl_double_backward above and bench.py's
+    # d_r1/g_pl phase numbers)
     def g_loss(v, z):
         return jnp.mean(G.apply(v, z, rngs=noise).astype(jnp.float32) ** 2)
 
@@ -166,6 +384,43 @@ def main() -> None:
     timed("g_fwd_bwd", lambda v, z: jax.grad(g_loss)(v, z), g_vars, z)
     timed("d_fwd_bwd", lambda v, x: jax.grad(d_loss)(v, x), d_vars, imgs)
 
+    # ---- step-share denominator + ranked attribution ------------------
+    phases, step_fl = (({}, None) if args.skip_phases
+                       else phase_flops(full_cfg, b))
+    if phases:
+        print(json.dumps({"name": "phase_flops",
+                          **{k: round(v / 1e9, 2) for k, v in
+                             phases.items()},
+                          "step_gflops_per_it": (
+                              round(step_fl / 1e9, 2) if step_fl
+                              else None)}), flush=True)
+    attribution = build_attribution(components, step_fl, proj_peak,
+                                    args.assumed_mfu, on_tpu)
+    artifact = {
+        "meta": meta,
+        "components": components,
+        "phase_gflops": {k: round(v / 1e9, 2) for k, v in phases.items()},
+        "step_gflops_per_iteration": (round(step_fl / 1e9, 2)
+                                      if step_fl else None),
+        "attribution": attribution,
+        "note": ("shares overlap (backward components contain their "
+                 "forward; phases fuse across component boundaries) — "
+                 "the table ranks time sinks, it does not partition the "
+                 "step" + ("" if on_tpu else
+                           "; CPU run: structure only, ms not meaningful")),
+    }
+    if args.json_out:
+        tmp = args.json_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f, indent=1)
+        os.replace(tmp, args.json_out)
+    print(json.dumps({"name": "attribution_top5",
+                      "top": [{k: r[k] for k in
+                               ("rank", "name", "gflops", "expected_ms",
+                                "share_of_step")}
+                              for r in attribution[:5]]}), flush=True)
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -27,6 +27,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from check_learning_trend import check  # noqa: E402  (sibling script)
 
 
+def copy_artifacts(run: str, out: str) -> list:
+    """Copy the run's record files into the evidence dir; returns the
+    copied basenames.  Flags are state, not series (VERDICT r5 weak #4):
+    a legacy all-constant ``metric-<flag>.txt`` pseudo-metric is NEVER
+    harvested; the ``flag-<name>.txt`` state files are copied as
+    themselves."""
+    from gansformer_tpu.metrics.metric_base import FLAG_KEYS
+
+    copied = []
+    for name in ["stats.jsonl", "config.json", "log.txt"]:
+        src = os.path.join(run, name)
+        if os.path.exists(src):
+            shutil.copy(src, out)
+            copied.append(name)
+    for src in glob.glob(os.path.join(run, "metric-*.txt")):
+        base = os.path.basename(src)
+        if base[len("metric-"):-len(".txt")] in FLAG_KEYS:
+            continue
+        shutil.copy(src, out)
+        copied.append(base)
+    for src in glob.glob(os.path.join(run, "flag-*.txt")):
+        shutil.copy(src, out)
+        copied.append(os.path.basename(src))
+    return copied
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("run_dir")
@@ -48,12 +74,7 @@ def main() -> None:
     with open(os.path.join(out, "trend.json"), "w") as f:
         json.dump(verdict, f, indent=1)
 
-    for name in ["stats.jsonl", "config.json", "log.txt"]:
-        src = os.path.join(run, name)
-        if os.path.exists(src):
-            shutil.copy(src, out)
-    for src in glob.glob(os.path.join(run, "metric-*.txt")):
-        shutil.copy(src, out)
+    copy_artifacts(run, out)
     fakes = sorted(glob.glob(os.path.join(run, "fakes*.png")))
     if fakes:
         shutil.copy(fakes[0], os.path.join(out, "grid_first.png"))

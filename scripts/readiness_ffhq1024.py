@@ -1,0 +1,131 @@
+"""ffhq1024 on-chip readiness probe (VERDICT r5 item 5, ISSUE 5 satellite).
+
+PERF.md §2's memory verdict (d_r1 11.0 / g_pl 16.9 GiB temp workspace at
+batch 8; "batch 4 on a v5e") comes from CPU lowering — indicative layout,
+never verified on the real backend.  This battery stage AOT-compiles the
+REAL ``d_step_r1`` / ``g_step_pl`` programs for the ffhq1024-duplex
+preset at batch 4 AND 8 on whatever backend is present, records
+``memory_analysis()`` per phase, and emits a fit verdict against the
+chip's HBM (from ``memory_stats()`` when the runtime exposes it, else the
+public per-chip table).  On CPU the numbers are the same indicative-layout
+figures PERF.md §2 used — the artifact labels which regime it is.
+
+  python scripts/readiness_ffhq1024.py [--preset ffhq1024-duplex] \
+      [--batches 4,8] [--json-out readiness.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Public per-chip HBM when the runtime doesn't say (GiB).
+HBM_GIB = [("v6", 32.0), ("v5e", 16.0), ("v5 lite", 16.0),
+           ("v5litepod", 16.0), ("v5p", 95.0), ("v5", 95.0),
+           ("v4", 32.0), ("v3", 16.0), ("v2", 8.0)]
+
+
+def hbm_limit_gib(device) -> float | None:
+    try:
+        stats = device.memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return stats["bytes_limit"] / 2**30
+    except Exception:
+        pass
+    dk = device.device_kind.lower()
+    for key, val in HBM_GIB:
+        if key in dk:
+            return val
+    return None
+
+
+def fit_verdict(state_gib, temp_gib, hbm_gib):
+    """Pure fit arithmetic (unit-tested): worst phase must hold the full
+    TrainState plus its temp workspace (PERF.md §2's reading)."""
+    if hbm_gib is None or temp_gib is None:
+        return {"fits": None, "margin_gib": None}
+    need = state_gib + temp_gib
+    return {"fits": bool(need <= hbm_gib),
+            "margin_gib": round(hbm_gib - need, 2)}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="ffhq1024-duplex")
+    p.add_argument("--batches", default="4,8")
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
+
+    enable_compile_cache(_REPO)
+
+    import numpy as np
+
+    from gansformer_tpu.core.config import get_preset
+    from gansformer_tpu.train.state import create_train_state
+    from gansformer_tpu.utils.benchcheck import lower_phase
+
+    cfg = get_preset(args.preset)
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    hbm = hbm_limit_gib(dev)
+    meta = {"device_kind": dev.device_kind, "platform": dev.platform,
+            "preset": args.preset, "hbm_gib": hbm,
+            "regime": ("device" if on_tpu
+                       else "cpu-lowering (indicative layout, PERF.md §2)")}
+    print(json.dumps(meta), flush=True)
+
+    key_s = jax.ShapeDtypeStruct((2,), np.uint32)
+    state_s = jax.eval_shape(lambda k: create_train_state(cfg, k), key_s)
+    state_gib = sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree_util.tree_leaves(state_s)) / 2**30
+
+    batches = []
+    for b in [int(s) for s in args.batches.split(",") if s.strip()]:
+        rec = {"batch": b, "phases": {}}
+        for name in ("d_r1", "g_pl"):
+            try:
+                # Shared lowering (benchcheck.lower_phase) — abstract
+                # state + conditional-label handling in one place.
+                ma = lower_phase(cfg, name, batch_size=b).memory_analysis()
+                ph = {"temp_gib": round(ma.temp_size_in_bytes / 2**30, 3),
+                      "argument_gib": round(
+                          ma.argument_size_in_bytes / 2**30, 3),
+                      "output_gib": round(
+                          ma.output_size_in_bytes / 2**30, 3)}
+            except Exception as e:
+                ph = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            ph.update(fit_verdict(state_gib, ph.get("temp_gib"), hbm))
+            rec["phases"][name] = ph
+            print(json.dumps({"batch": b, "phase": name, **ph}),
+                  flush=True)
+        worst = [p_.get("fits") for p_ in rec["phases"].values()]
+        rec["fits"] = (None if any(f is None for f in worst)
+                       else bool(all(worst)))
+        batches.append(rec)
+
+    artifact = {"meta": meta, "state_gib": round(state_gib, 3),
+                "batches": batches}
+    if args.json_out:
+        tmp = args.json_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f, indent=1)
+        os.replace(tmp, args.json_out)
+    print(json.dumps({"readiness_done": [r["batch"] for r in batches],
+                      "fits": {r["batch"]: r["fits"] for r in batches}}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,6 +5,7 @@ the logic that decides whether a number is real must be unit-testable).
 plain dicts — no device, no jax — so these tests pin the exact artifact
 schema (PERF.md §4) and the suspect-flagging behavior the judge reads."""
 
+import json
 import os
 import sys
 
@@ -145,3 +146,98 @@ def test_tick_probe_extracts_overlap_evidence():
     assert out["phase_self_ms"]["save"] == 8.0   # last tick's breakdown
     assert out["phase_self_ms"]["h2d"] == 0.4 / 1000 * 1000
     assert bench.build_tick_probe([{"x": 1}]) == {"error": "no tick records"}
+
+
+# --- bench_components attribution table (ISSUE 5) ---------------------------
+
+def _load_components():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_components",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "bench_components.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)   # stdlib-only at import time, like bench
+    return mod
+
+
+COMPONENTS = [
+    {"name": "pl_double_backward", "gflops": 2900.0, "gbytes": 40.0,
+     "ms": 44.0, "mfu": 0.33},
+    {"name": "modconv3x3_up2_128", "gflops": 400.0, "gbytes": 8.0,
+     "ms": 6.2, "mfu": 0.32},
+    {"name": "blur_up2_32", "gflops": 1.0, "gbytes": 0.1, "ms": 0.05,
+     "mfu": 0.1},
+    {"name": "init", "s": 12.0},          # no gflops → unranked tail
+]
+
+
+def test_attribution_table_ranked_with_shares():
+    bc = _load_components()
+    step_fl = 3.97e12
+    rows = bc.build_attribution(COMPONENTS, step_fl, peak_tflops=197.0,
+                                assumed_mfu=0.33, on_tpu=False)
+    assert [r["rank"] for r in rows] == [1, 2, 3, 4]
+    assert rows[0]["name"] == "pl_double_backward"
+    # expected ms @ assumed MFU: flops / (mfu * peak)
+    assert rows[0]["expected_ms"] == pytest.approx(
+        2900e9 / (0.33 * 197e12) * 1e3, rel=1e-3)
+    # share of the cadence-weighted step
+    assert rows[0]["share_of_step"] == pytest.approx(2900e9 / step_fl,
+                                                     abs=1e-3)
+    # CPU run: measured ms is withheld (structure only)
+    assert rows[0]["ms_measured"] is None
+    assert rows[-1]["name"] == "init" and rows[-1]["expected_ms"] is None
+
+
+def test_attribution_table_prefers_measured_ms_on_tpu():
+    bc = _load_components()
+    comps = [dict(COMPONENTS[0]), dict(COMPONENTS[1])]
+    comps[1]["ms"] = 99.0       # slower than its FLOPs predict (bound
+    rows = bc.build_attribution(comps, None, 197.0, 0.33, on_tpu=True)
+    assert rows[0]["name"] == "modconv3x3_up2_128"   # measured ms wins
+    assert rows[0]["ms_measured"] == 99.0
+    assert rows[0]["mfu_measured"] == 0.32
+    assert rows[0]["share_of_step"] is None          # no denominator
+
+
+def test_attribution_expected_ms_helper():
+    bc = _load_components()
+    # 1 TFLOP at 50% of a 200 TFLOP/s chip = 10 ms
+    assert bc.expected_ms(1e12, 200.0, 0.5) == pytest.approx(10.0)
+
+
+@pytest.mark.slow   # compiles every component + the four phase programs
+def test_bench_components_end_to_end_cpu(tmp_path):
+    """The attribution tentpole on a small preset: the script runs on CPU
+    (structure mode), emits the artifact, and the ranked table carries the
+    four-phase component set with shares against the step denominator."""
+    bc = _load_components()
+    out = tmp_path / "components.json"
+    rc = bc.main(["--preset", "clevr64-simplex", "--batch", "4",
+                  "--iters", "1", "--json-out", str(out)])
+    assert rc == 0
+    art = json.load(open(out))
+    names = {c["name"] for c in art["components"]}
+    # the four phases' expected sinks are all represented
+    assert "pl_double_backward" in names
+    assert any(n.startswith("d_front_") for n in names)
+    assert any(n.startswith("attn_block_") for n in names)
+    assert any(n.startswith("attn_einsums_") for n in names)
+    assert any(n.startswith("modconv3x3_up2_vjp_") for n in names)
+    # phase denominator + ranked shares
+    assert set(art["phase_gflops"]) == {"d", "g", "d_r1", "g_pl"}
+    assert art["step_gflops_per_iteration"] > 0
+    rows = art["attribution"]
+    assert [r["rank"] for r in rows] == list(range(1, len(rows) + 1))
+    ranked = [r for r in rows if r["expected_ms"] is not None]
+    assert all(a["expected_ms"] >= b["expected_ms"]
+               for a, b in zip(ranked, ranked[1:]))
+    for r in ranked:
+        assert r["share_of_step"] is not None and r["share_of_step"] > 0
+        assert r["ms_measured"] is None     # CPU: structure only
+    # the double-backward must rank above any leaf blur — sanity of the
+    # cost model itself
+    rank = {r["name"]: r["rank"] for r in rows}
+    assert rank["pl_double_backward"] < rank["blur_up2_32"]

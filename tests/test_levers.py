@@ -1,0 +1,372 @@
+"""Acceptance contracts for the flag-gated MFU levers (ISSUE 5).
+
+Every lever is held to its contract on the CPU mesh before it may claim
+tunnel minutes: ``pl_batch_shrink`` — expectation-parity at shrink=1 and
+strictly lower cost-analysis FLOPs as the shrink grows; ``r1_batch_shrink``
+— slice semantics match an explicit penalty on the slice, the main D loss
+is untouched, FLOPs strictly lower; ``attn_fused_kv`` — EXACT math under
+weight concatenation.  The A/B pricing harness (scripts/ab_levers.py) is
+covered by its pure helpers + a slow-marked end-to-end run."""
+
+import dataclasses
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gansformer_tpu.core.config import get_preset
+from gansformer_tpu.losses.gan import r1_penalty, r1_slice
+from gansformer_tpu.train.state import create_train_state
+from gansformer_tpu.train.steps import make_train_steps
+from gansformer_tpu.utils.benchcheck import flops_of
+from tests.test_train import micro_cfg
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _with_train(cfg, **kv):
+    return dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, **kv))
+
+
+def _phase_flops(cfg, phase):
+    # the same shared lowering the measurement scripts use
+    from gansformer_tpu.utils.benchcheck import lower_phase
+
+    return flops_of(lower_phase(cfg, phase))
+
+
+def _host_params(tree):
+    # np.array (copy=True), NOT np.asarray: on CPU an asarray view can
+    # alias the jax buffer, and the step below DONATES the state — the
+    # "pre-step copy" would silently mutate under the donation.
+    return jax.tree_util.tree_map(lambda x: np.array(x), tree)
+
+
+# --- r1_slice / config plumbing (pure) ----------------------------------
+
+def test_r1_slice_unit():
+    x = jnp.arange(8.0)[:, None]
+    assert r1_slice(x, 1) is x
+    np.testing.assert_array_equal(r1_slice(x, 2), np.arange(4.0)[:, None])
+    np.testing.assert_array_equal(r1_slice(x, 4), np.arange(2.0)[:, None])
+    with pytest.raises(AssertionError):
+        r1_slice(x, 3)                  # non-divisible must fail loudly
+
+
+def test_config_validates_r1_batch_shrink():
+    cfg = _with_train(micro_cfg(), r1_batch_shrink=3)   # 8 % 3 != 0
+    with pytest.raises(ValueError, match="r1_batch_shrink"):
+        cfg.validate()
+    with pytest.raises(ValueError, match="r1_batch_shrink"):
+        _with_train(micro_cfg(), r1_batch_shrink=0).validate()
+    _with_train(micro_cfg(), r1_batch_shrink=2).validate()
+
+
+def test_config_validates_pl_batch_shrink_range():
+    """A typo'd --pl-batch-shrink 0 must fail loudly, not silently run
+    the most expensive (full-probe) variant via steps.py's max(1, ·)."""
+    with pytest.raises(ValueError, match="pl_batch_shrink"):
+        _with_train(micro_cfg(), pl_batch_shrink=0).validate()
+    with pytest.raises(ValueError, match="pl_batch_shrink"):
+        _with_train(micro_cfg(), pl_batch_shrink=-2).validate()
+    with pytest.raises(ValueError, match="pl_batch_shrink"):
+        _with_train(micro_cfg(), pl_batch_shrink=3).validate()  # 8 % 3
+    _with_train(micro_cfg(), pl_batch_shrink=4).validate()
+
+
+def test_cli_lever_flags_round_trip():
+    from gansformer_tpu.cli.train import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["--preset", "clevr64-simplex", "--batch-size", "8",
+         "--pl-batch-shrink", "4", "--r1-batch-shrink", "2",
+         "--attn-fused-kv"])
+    cfg = config_from_args(args)
+    assert cfg.train.pl_batch_shrink == 4
+    assert cfg.train.r1_batch_shrink == 2
+    assert cfg.model.attn_fused_kv is True
+    # defaults: levers OFF / reference values, tri-state inherits
+    args = build_parser().parse_args(["--preset", "clevr64-simplex"])
+    cfg = config_from_args(args)
+    assert cfg.train.pl_batch_shrink == 2       # reference default
+    assert cfg.train.r1_batch_shrink == 1       # lever off
+    assert cfg.model.attn_fused_kv is False     # lever off
+    args = build_parser().parse_args(
+        ["--preset", "clevr64-simplex", "--no-attn-fused-kv"])
+    assert config_from_args(args).model.attn_fused_kv is False
+
+
+def test_flagship_preset_defaults_keep_levers_off():
+    t = get_preset("ffhq256-duplex").train
+    assert t.r1_batch_shrink == 1
+    assert t.pl_batch_shrink == 2               # StyleGAN2 reference value
+    assert get_preset("ffhq256-duplex").model.attn_fused_kv is False
+
+
+# --- attn_fused_kv: exact parity under weight concatenation -------------
+
+def test_attn_fused_kv_parity():
+    """Fused K∥V projection must be the SAME function: build both
+    variants, assemble the fused weights from the unfused ones by column
+    concatenation, and require matching outputs (grid AND latents)."""
+    from gansformer_tpu.models.attention import BipartiteAttention
+
+    kw = dict(grid_dim=16, latent_dim=16, duplex=True, integration="both",
+              kmeans_iters=1, pos_encoding="sinusoidal")
+    m0 = BipartiteAttention(fused_kv=False, **kw)
+    m1 = BipartiteAttention(fused_kv=True, **kw)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 8, 8, 16), jnp.float32)
+    y = jnp.asarray(rs.randn(2, 3, 16), jnp.float32)
+    k = jax.random.PRNGKey(0)
+    p0 = m0.init(k, x, y)["params"]
+    p1 = jax.tree_util.tree_map(np.copy, m1.init(k, x, y)["params"])
+
+    def fuse(a, b):
+        return {"w": np.concatenate([p0[a]["w"], p0[b]["w"]], axis=1),
+                "b": np.concatenate([p0[a]["b"], p0[b]["b"]])}
+
+    for name in p1:
+        if name == "kv_y":
+            p1[name] = fuse("k_y", "v_y")
+        elif name.endswith("_kv_x"):
+            stem = name[:-len("_kv_x")]
+            p1[name] = fuse(f"{stem}_k_x", f"{stem}_v_x")
+        else:
+            p1[name] = p0[name]
+
+    g0, y0 = m0.apply({"params": p0}, x, y)
+    g1, y1 = m1.apply({"params": p1}, x, y)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_attn_fused_kv_builds_through_model_config():
+    """The config flag reaches both G and D attention blocks: the fused
+    param names exist, the unfused ones are gone."""
+    import dataclasses as dc
+
+    cfg = micro_cfg(attention="duplex")
+    cfg = dc.replace(cfg, model=dc.replace(cfg.model, attn_fused_kv=True))
+    state = create_train_state(cfg, jax.random.PRNGKey(0))
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path)
+            for path, _ in jax.tree_util.tree_leaves_with_path(
+                state.g_params)}
+    assert any("kv_y" in p for p in flat)
+    assert not any("/k_y/" in p or "/v_y/" in p for p in flat)
+
+
+# --- pl_batch_shrink ----------------------------------------------------
+
+class TestPlBatchShrink:
+    def test_flops_strictly_lower_as_shrink_grows(self):
+        cfg = micro_cfg()
+        fl = {s: _phase_flops(_with_train(cfg, pl_batch_shrink=s), "g_pl")
+              for s in (1, 2, 4)}
+        assert fl[1] and fl[2] and fl[4]
+        assert fl[2] < fl[1], fl
+        assert fl[4] < fl[2], fl
+
+    def test_expectation_parity_at_shrink_1(self):
+        """At shrink=1 the probe is the full fresh batch and the penalty
+        must equal an explicit path_length_penalty evaluated with the
+        same rng derivation — no hidden rescaling from the lever."""
+        from gansformer_tpu.losses.gan import path_length_penalty
+        from gansformer_tpu.models.generator import Generator
+
+        cfg = _with_train(micro_cfg(), pl_batch_shrink=1)
+        fns = make_train_steps(cfg, batch_size=cfg.train.batch_size)
+        state = create_train_state(cfg, jax.random.PRNGKey(0))
+        g_params = _host_params(state.g_params)   # state is donated below
+        pl_mean0 = float(state.pl_mean)
+        rng = jax.random.PRNGKey(123)
+        _, aux = fns.g_step_pl(state, rng)
+
+        G = Generator(cfg.model)
+        k_pl, k_plnoise = jax.random.split(jax.random.fold_in(rng, 3))
+        z_pl = jax.random.normal(
+            k_pl, (cfg.train.batch_size, cfg.model.num_ws,
+                   cfg.model.latent_dim), jnp.float32)
+        ws_pl = G.apply({"params": g_params}, z_pl, None,
+                        method=Generator.map)
+
+        def synth(w):
+            return G.apply({"params": g_params}, w,
+                           rngs={"noise": jax.random.fold_in(rng, 4)},
+                           method=Generator.synthesize)
+
+        pl, _ = path_length_penalty(synth, ws_pl, jnp.asarray(pl_mean0),
+                                    k_plnoise, cfg.train.pl_decay)
+        np.testing.assert_allclose(float(aux["Loss/G/pl"]), float(pl),
+                                   rtol=1e-4)
+
+    def test_main_g_loss_untouched_by_shrink(self):
+        """The adversarial term must be identical across shrink settings
+        (the lever only touches the PL probe)."""
+        auxes = {}
+        for s in (1, 2):
+            cfg = _with_train(micro_cfg(), pl_batch_shrink=s)
+            fns = make_train_steps(cfg, batch_size=cfg.train.batch_size)
+            state = create_train_state(cfg, jax.random.PRNGKey(0))
+            _, auxes[s] = fns.g_step_pl(state, jax.random.PRNGKey(123))
+        np.testing.assert_allclose(float(auxes[1]["Loss/G"]),
+                                   float(auxes[2]["Loss/G"]), rtol=1e-5)
+
+
+# --- r1_batch_shrink ----------------------------------------------------
+
+class TestR1BatchShrink:
+    def test_flops_strictly_lower_at_shrink_2(self):
+        cfg = micro_cfg()
+        fl1 = _phase_flops(_with_train(cfg, r1_batch_shrink=1), "d_r1")
+        fl2 = _phase_flops(_with_train(cfg, r1_batch_shrink=2), "d_r1")
+        assert fl1 and fl2
+        assert fl2 < fl1, (fl1, fl2)
+
+    def test_slice_semantics_and_main_loss_parity(self):
+        """With the lever armed the logged penalty equals an explicit
+        r1_penalty on the first half of the normalized batch (unbiased
+        slice, weight unchanged); the main D loss matches the unsliced
+        step exactly (same reals/fakes/scores)."""
+        from gansformer_tpu.data.dataset import normalize_images
+        from gansformer_tpu.models.discriminator import Discriminator
+
+        imgs = np.random.RandomState(1).randint(
+            0, 255, (8, 16, 16, 3)).astype(np.uint8)
+        rng = jax.random.PRNGKey(7)
+        auxes = {}
+        d_params_host = None
+        for s in (1, 2):
+            cfg = _with_train(micro_cfg(), r1_batch_shrink=s)
+            fns = make_train_steps(cfg, batch_size=cfg.train.batch_size)
+            state = create_train_state(cfg, jax.random.PRNGKey(0))
+            if d_params_host is None:
+                d_params_host = _host_params(state.d_params)
+            _, auxes[s] = fns.d_step_r1(state, jnp.asarray(imgs), rng)
+        np.testing.assert_allclose(float(auxes[1]["Loss/D"]),
+                                   float(auxes[2]["Loss/D"]), rtol=1e-5)
+
+        D = Discriminator(micro_cfg().model)
+        reals = normalize_images(jnp.asarray(imgs))
+        manual = r1_penalty(
+            lambda x: D.apply({"params": d_params_host}, x),
+            r1_slice(reals, 2))
+        np.testing.assert_allclose(float(auxes[2]["Loss/D/r1"]),
+                                   float(manual), rtol=1e-4)
+
+
+# --- ab_levers harness --------------------------------------------------
+
+def test_ab_levers_catalog_covers_the_wired_levers():
+    ab = _load_script("ab_levers")
+    catalog = {lv["name"]: lv for lv in ab.lever_catalog()}
+    assert set(catalog) == {"pl_batch_shrink", "r1_batch_shrink",
+                            "attn_fused_kv"}
+    for lv in catalog.values():
+        settings = [s for s, _ in lv["variants"]]
+        assert lv["baseline"] in settings
+        assert lv["phase"] in ("d", "d_r1", "g", "g_pl")
+        assert "tests/test_levers.py" in lv["test"]
+    # catalog transforms really flip the config fields
+    cfg = micro_cfg()
+    assert catalog["pl_batch_shrink"]["variants"][2][1](
+        cfg).train.pl_batch_shrink == 4
+    assert catalog["attn_fused_kv"]["variants"][1][1](
+        cfg).model.attn_fused_kv is True
+
+
+def test_ab_levers_delta_attachment_pure():
+    ab = _load_script("ab_levers")
+    lever = {"name": "x", "baseline": "1",
+             "variants": [{"setting": "1", "gflops": 10.0, "ms": 5.0,
+                           "gbytes": 2.0, "temp_gib": 1.0},
+                          {"setting": "2", "gflops": 7.5, "ms": 4.0,
+                           "gbytes": 1.5, "temp_gib": 0.8},
+                          {"setting": "err", "error": "boom"}]}
+    out = ab.attach_deltas(lever)
+    v1, v2, verr = out["variants"]
+    assert v1["is_baseline"] and not v2["is_baseline"]
+    assert v2["delta_gflops"] == -2.5 and v2["delta_ms"] == -1.0
+    assert "delta_gflops" not in verr           # errors carry no deltas
+
+
+@pytest.mark.slow   # compiles micro g_pl three times end-to-end
+def test_ab_levers_script_end_to_end_cpu(tmp_path):
+    ab = _load_script("ab_levers")
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(micro_cfg().to_json())
+    out = tmp_path / "ab.json"
+    rc = ab.main(["--config", str(cfg_path), "--batch", "8", "--iters",
+                  "1", "--levers", "pl_batch_shrink",
+                  "--json-out", str(out)])
+    assert rc == 0
+    art = json.load(open(out))
+    (lever,) = art["levers"]
+    by_setting = {v["setting"]: v for v in lever["variants"]}
+    assert by_setting["2"]["is_baseline"]
+    # CPU run: FLOPs deltas exact, ms null
+    assert by_setting["1"]["delta_gflops"] > 0
+    assert by_setting["4"]["delta_gflops"] < 0
+    assert by_setting["4"]["ms"] is None
+
+
+# --- ffhq1024 readiness stage (pure core) -------------------------------
+
+def test_readiness_fit_verdict_pure():
+    rd = _load_script("readiness_ffhq1024")
+    v = rd.fit_verdict(state_gib=0.93, temp_gib=16.85, hbm_gib=32.0)
+    assert v["fits"] is True and v["margin_gib"] == pytest.approx(14.22)
+    v = rd.fit_verdict(state_gib=0.93, temp_gib=16.85, hbm_gib=16.0)
+    assert v["fits"] is False
+    assert rd.fit_verdict(0.93, None, 16.0)["fits"] is None
+    assert rd.fit_verdict(0.93, 1.0, None)["fits"] is None
+
+
+def test_readiness_hbm_table():
+    rd = _load_script("readiness_ffhq1024")
+
+    class Dev:
+        device_kind = "TPU v5 lite"
+
+        def memory_stats(self):
+            return {}
+
+    assert rd.hbm_limit_gib(Dev()) == 16.0
+
+    class Dev4(Dev):
+        device_kind = "TPU v4"
+
+        def memory_stats(self):
+            return {"bytes_limit": 34088157184}
+
+    assert rd.hbm_limit_gib(Dev4()) == pytest.approx(31.75, abs=0.01)
+
+
+@pytest.mark.slow   # compiles d_r1/g_pl twice (batch 2 and 4)
+def test_readiness_script_end_to_end_cpu(tmp_path):
+    rd = _load_script("readiness_ffhq1024")
+    out = tmp_path / "ready.json"
+    rc = rd.main(["--preset", "clevr64-simplex", "--batches", "2,4",
+                  "--json-out", str(out)])
+    assert rc == 0
+    art = json.load(open(out))
+    assert art["meta"]["regime"].startswith("cpu-lowering")
+    assert [r["batch"] for r in art["batches"]] == [2, 4]
+    for rec in art["batches"]:
+        for ph in ("d_r1", "g_pl"):
+            assert rec["phases"][ph]["temp_gib"] >= 0
