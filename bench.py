@@ -74,6 +74,14 @@ _INNER_FLAG = "_GRAFT_BENCH_INNER"
 _SELF = os.path.abspath(__file__)
 _REPO = os.path.dirname(_SELF)
 _PHASES_OUT = os.path.join(_REPO, ".bench_phases.json")
+# graftcomms attribution artifact (gansformer-lint --trace --json-out;
+# the battery's graftcomms stage refreshes it) — when present, the
+# bench artifact carries an expected-DP-scaling-efficiency section.
+_COMMS_JSON = os.environ.get(
+    "GRAFT_COMMS_JSON", os.path.join(_REPO, ".comms_attribution.json"))
+# Order-of-magnitude per-chip ICI budget (~v4/v5e class); the scaling
+# section reports the assumption so a reader can re-scale it.
+ICI_BYTES_PER_S = 9.0e10
 
 
 def _log(msg: str) -> None:
@@ -150,6 +158,60 @@ def build_phase_artifact(*, metric: str, on_tpu: bool, n_chips: int,
     if partial:
         out["partial"] = "reg variants not yet measured"
     return out
+
+
+def build_expected_scaling(comms_payload: dict, phase_ms: dict,
+                           ici_bytes_per_s: float = ICI_BYTES_PER_S):
+    """graftcomms attribution (``scaling_bytes_per_device``: per-entry
+    predicted wire bytes vs chip count) + this run's measured per-phase
+    ms → expected data-parallel scaling efficiency per phase per chip
+    count (PURE; the efficiency model lives in
+    analysis/trace/collective_flow.py — serial no-overlap ring, a floor
+    not a forecast).  Returns None when the artifact and the timings
+    share no phase, or when the capture never compiled a ≥2-device
+    mesh (a single-chip tunnel window records zero collectives —
+    presenting that as perfect scaling would be exactly the
+    device-starved false-clean the artifact's coverage fields exist to
+    prevent) — ROADMAP item 2's "report scaling efficiency vs chip
+    count" before any multi-chip hardware exists."""
+    from gansformer_tpu.analysis.trace.collective_flow import (
+        scaling_efficiency)
+
+    if not any(int(n) >= 2
+               for n in comms_payload.get("mesh_sizes_compiled") or []):
+        return None
+
+    phase_of = {"d_step": "d", "d_step_r1": "d_r1",
+                "g_step": "g", "g_step_pl": "g_pl"}
+    per_phase: dict = {}
+    for entry, per_chip in (comms_payload.get("scaling_bytes_per_device")
+                            or {}).items():
+        tail = entry.split(".", 1)[1] if "." in entry else entry
+        phase = phase_of.get(tail.split("[", 1)[0])
+        if phase is None or phase not in phase_ms or phase in per_phase:
+            continue
+        step_s = phase_ms[phase] / 1e3
+        per_phase[phase] = {
+            c: round(scaling_efficiency(int(w), step_s, ici_bytes_per_s), 4)
+            for c, w in sorted(per_chip.items(), key=lambda kv: int(kv[0]))}
+    if not per_phase:
+        return None
+    return {
+        "assumed_ici_bytes_per_s": ici_bytes_per_s,
+        "model": "serial no-overlap ring comms on top of the measured "
+                 "phase time — an efficiency floor, not a forecast",
+        "per_phase_efficiency": per_phase,
+        "comms_profile": comms_payload.get("trace_profile"),
+    }
+
+
+def _load_comms_payload(path: str = None):
+    path = path or _COMMS_JSON
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def build_cycle_artifact(*, metric: str, n_chips: int, platform: str,
@@ -300,6 +362,12 @@ class _BenchSession:
             # donated-arg HBM buffers) for the witness — a sweep OOM under
             # this flag may not reproduce untraced; make it attributable.
             out["trace_mode"] = True
+        if "phase_ms" in out:
+            comms = _load_comms_payload()
+            if comms is not None:
+                scal = build_expected_scaling(comms, out["phase_ms"])
+                if scal is not None:
+                    out["expected_scaling"] = scal
         self.last_out.clear()
         self.last_out.update(out)
         print(json.dumps(out), flush=True)

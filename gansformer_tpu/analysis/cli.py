@@ -6,17 +6,22 @@ Usage::
     gansformer-lint --format json path/to/file.py     # machine output
     gansformer-lint --trace gansformer_tpu scripts    # AST + jaxpr rules
     gansformer-lint --trace --trace-profile full      # the whole matrix
+    gansformer-lint --trace --json-out comms.json     # graftcomms table
     gansformer-lint --fix-baseline gansformer_tpu scripts
     gansformer-lint --list-rules
     gansformer-lint --run-dir results/00003-run       # artifact schema
 
-``--trace`` adds the jaxpr-level semantic rules (ISSUE 4,
+``--trace`` adds the jaxpr-level semantic rules (ISSUEs 4+6,
 ``analysis/trace/``): the repo's real jitted entry points are traced
 with abstract inputs and checked for retrace hazards, const bloat,
-silent dtype promotion, and sharding-vs-intent drift.  Trace findings
-ride the same suppression/baseline/exit-code machinery.  When jax has
-not been imported yet, the CLI forces a 2-CPU-device backend so the
-sharding audit has a mesh to resolve against.
+silent dtype promotion, and — via the graftcomms layer — sharding
+contracts and collective-flow anti-patterns over the SPMD-compiled
+programs.  Trace findings ride the same suppression/baseline/exit-code
+machinery; ``--json-out`` additionally exports the ranked per-entry
+comms-bytes table + the bytes-vs-chip-count scaling prediction.  When
+jax has not been imported yet, the CLI forces a 4-CPU-device backend
+so the mesh matrix has devices to resolve against (``--trace-native``
+keeps the ambient backend instead — the battery's TPU capture).
 
 Exit codes: 0 — no new findings; 1 — new findings (or schema errors);
 2 — usage error.  "New" excludes inline-suppressed findings and entries
@@ -118,22 +123,39 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace", action="store_true",
                    help="also run the jaxpr-level trace rules against the "
                         "repo's real jitted entry points (retrace hazards, "
-                        "const bloat, dtype promotion, sharding audit)")
-    p.add_argument("--trace-profile", choices=("structural", "fast", "full"),
+                        "const bloat, dtype promotion, sharding/contract/"
+                        "collective audits)")
+    p.add_argument("--trace-profile",
+                   choices=("structural", "contracts", "fast", "full"),
                    default="fast",
                    help="trace cost/coverage: structural = tracing only "
-                        "(no compiles); fast = + retrace/sharding probes "
-                        "on the plain train steps; full = every rule on "
-                        "every matrix entry point")
+                        "(no compiles); contracts = + the PartitionSpec "
+                        "contract check on the four train steps; fast = "
+                        "+ retrace/sharding/collective probes on the "
+                        "train steps; full = every rule on every matrix "
+                        "entry point across the 1/2/4-device mesh matrix")
+    p.add_argument("--trace-native", action="store_true",
+                   help="compile the trace rules on the AMBIENT jax "
+                        "backend instead of forcing virtual CPU devices "
+                        "— the battery uses this to capture a TPU-"
+                        "compiled comms table (mesh sizes clamp to the "
+                        "devices the backend exposes)")
+    p.add_argument("--json-out", default=None, metavar="PATH",
+                   help="with --trace: write the graftcomms artifact "
+                        "(ranked per-entry comms-bytes table + the "
+                        "bytes-vs-chip-count scaling prediction) to PATH "
+                        "— the comms twin of bench_components.py's "
+                        "--json-out FLOP attribution")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="also print suppressed/baselined findings")
     return p
 
 
 def _force_virtual_devices() -> None:
-    """Give the process ≥2 CPU devices for the sharding audit — only
-    possible before jax initializes its backends; a no-op (with the
-    audit falling back to a skip-note) when jax is already live."""
+    """Give the process enough CPU devices for the mesh-compiling rules
+    (the 4-device member of the simulated mesh matrix) — only possible
+    before jax initializes its backends; a no-op (with the audits
+    falling back to skip-notes) when jax is already live."""
     import sys as _sys
 
     if "jax" in _sys.modules:
@@ -142,25 +164,51 @@ def _force_virtual_devices() -> None:
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=2").strip()
+            flags + " --xla_force_host_platform_device_count=4").strip()
 
 
-def run_trace_findings(profile: str, trace_rules) -> List[Finding]:
-    """Trace-rule findings for the CLI/selfcheck path (device setup +
-    harness; see analysis/trace/harness.py for profile semantics)."""
-    _force_virtual_devices()
+def run_trace_findings(profile: str, trace_rules, native: bool = False):
+    """(findings, comms_payload) for the CLI/selfcheck path — device
+    setup + harness (see analysis/trace/harness.py for profile
+    semantics).  ``comms_payload`` is the graftcomms attribution dict
+    (ranked table + scaling prediction; empty sections when no
+    mesh-compiling rule ran).  The payload distinguishes the REQUESTED
+    mesh matrix from the sizes that actually COMPILED and carries the
+    harness skip-notes, so a device-starved host (1-chip tunnel window,
+    un-forced selfcheck process) reads as partial coverage, not as a
+    clean zero-collective table."""
+    if not native:
+        _force_virtual_devices()
+    from gansformer_tpu.analysis.trace.collective_flow import (
+        ranked_comms_table, scaling_report)
     from gansformer_tpu.analysis.trace.harness import run_trace
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
 
-    findings, _ctx = run_trace(profile, rules=trace_rules)
-    return findings
+    enable_compile_cache()    # the contract compiles are cache-keyed by
+    # HLO: pre-commit / selfcheck re-runs hit the persistent cache
+    findings, ctx = run_trace(profile, rules=trace_rules)
+    payload = {
+        "comms": ranked_comms_table(ctx.comms),
+        "scaling_bytes_per_device": scaling_report(ctx.comms),
+        "trace_profile": profile,
+        "mesh_sizes_requested": list(ctx.mesh_sizes),
+        "mesh_sizes_compiled": sorted(ctx.meshes_compiled),
+        "notes": list(ctx.notes),
+    }
+    return findings, payload
 
 
-def run_selfcheck(run_dir: str, trace_profile: str = "fast") -> int:
+def run_selfcheck(run_dir: str, trace_profile: str = "contracts") -> int:
     """One-command AST + trace lint with a JSON artifact in the run dir
     (``cli/train.py --selfcheck``).  Lints the installed package tree +
     ``scripts/`` when present, applies the checked-in baseline, writes
     ``<run_dir>/graftlint.json``, and returns the number of NEW
-    findings (0 = clean, training may proceed)."""
+    findings (0 = clean, training may proceed).  The default trace
+    profile is ``contracts``: the structural rules plus the
+    PartitionSpec-contract check on the four train-step programs — a
+    mis-partitioned step aborts before it burns accelerator hours.
+    Runs NATIVE (no CPU-device forcing): selfcheck executes inside the
+    training process, whose backend is already configured."""
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     paths = [os.path.join(pkg_root, "gansformer_tpu")]
@@ -173,13 +221,15 @@ def run_selfcheck(run_dir: str, trace_profile: str = "fast") -> int:
     findings: List[Finding] = []
     for path in files:
         findings.extend(engine.lint_file(path, rules=rules))
-    findings.extend(run_trace_findings(trace_profile, trace_rules))
+    trace_findings, comms = run_trace_findings(trace_profile, trace_rules,
+                                               native=True)
+    findings.extend(trace_findings)
     if os.path.exists(DEFAULT_BASELINE):
         Baseline.load(DEFAULT_BASELINE).apply(findings, line_text_lookup())
 
     artifact = os.path.join(run_dir, "graftlint.json")
     with open(artifact, "w", encoding="utf-8") as f:
-        f.write(reporters.render_json(findings, len(files)))
+        f.write(reporters.render_json(findings, len(files), extra=comms))
         f.write("\n")
     return sum(1 for f in findings if f.new)
 
@@ -209,6 +259,11 @@ def main(argv=None) -> int:
         print("gansformer-lint: --learning-trend needs --run-dir",
               file=sys.stderr)
         return 2
+    if (args.json_out or args.trace_native) and not args.trace:
+        print("gansformer-lint: --json-out/--trace-native need --trace "
+              "(the comms table comes from the compiled trace programs)",
+              file=sys.stderr)
+        return 2
 
     try:
         rules, trace_rules = _select_rules(args.select, args.ignore,
@@ -236,10 +291,30 @@ def main(argv=None) -> int:
     for path in files:
         findings.extend(engine.lint_file(path, rules=rules))
 
-    if args.trace and trace_rules:
-        # trace findings join BEFORE baseline application so they can be
-        # baselined/suppressed exactly like AST findings
-        findings.extend(run_trace_findings(args.trace_profile, trace_rules))
+    comms_payload = None
+    if args.trace:
+        if trace_rules:
+            # trace findings join BEFORE baseline application so they can
+            # be baselined/suppressed exactly like AST findings
+            trace_findings, comms_payload = run_trace_findings(
+                args.trace_profile, trace_rules, native=args.trace_native)
+            findings.extend(trace_findings)
+        else:
+            # --ignore filtered every trace rule away: the artifact must
+            # still exist (and say why it's empty) — a consumer finding
+            # no file after a green exit is worse than an empty table
+            comms_payload = {
+                "comms": [], "scaling_bytes_per_device": {},
+                "trace_profile": args.trace_profile,
+                "mesh_sizes_requested": [], "mesh_sizes_compiled": [],
+                "notes": ["no trace rules selected"]}
+        if args.json_out:
+            import json as _json
+
+            with open(args.json_out, "w", encoding="utf-8") as f:
+                _json.dump({"version": 1, **comms_payload}, f, indent=1,
+                           sort_keys=True)
+                f.write("\n")
 
     line_text = line_text_lookup()
 
@@ -268,7 +343,8 @@ def main(argv=None) -> int:
             findings.extend(lint_learning_trend(args.run_dir))
 
     if args.format == "json":
-        print(reporters.render_json(findings, len(files)))
+        print(reporters.render_json(findings, len(files),
+                                    extra=comms_payload))
     else:
         print(reporters.render_text(findings, len(files),
                                     verbose=args.verbose))

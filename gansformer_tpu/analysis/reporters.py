@@ -42,14 +42,18 @@ def render_text(findings: List[Finding], files_checked: int,
     return "\n".join(lines)
 
 
-def render_json(findings: List[Finding], files_checked: int) -> str:
+def render_json(findings: List[Finding], files_checked: int,
+                extra: dict = None) -> str:
+    """``extra`` merges additional top-level sections into the payload
+    (the trace run's comms-cost table rides here) — reserved keys win."""
     c = counts(findings)
-    payload = {
+    payload = dict(extra or {})
+    payload.update({
         "version": 1,
         "ok": c["new"] == 0,
         "files_checked": files_checked,
         "counts": c,
         "findings": [f.to_dict()
                      for f in sorted(findings, key=Finding.sort_key)],
-    }
+    })
     return json.dumps(payload, indent=1, sort_keys=True)

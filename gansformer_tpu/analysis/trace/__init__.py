@@ -18,6 +18,22 @@ ever looking wrong in source:
                        params and donation-defeating output shardings,
                        resolved on a fake 2-device mesh.
 
+The graftcomms layer (ISSUE 6) extends the dynamic half over the
+SPMD-compiled programs, against the declared layout in
+``parallel/contracts.py`` and across a 1/2/4-device simulated mesh
+matrix (compiles shared through ``TraceContext.compiled``):
+
+* ``partition_contract`` — partition-contract: resolved input/output/
+                       donated-leaf shardings must match the intended
+                       PartitionSpec per arg role per entry point.
+* ``collective_flow``  — collective-flow: per-collective bytes-moved
+                       attribution (the ranked comms table behind
+                       ``gansformer-lint --json-out`` and bench.py's
+                       expected-scaling section) + anti-pattern
+                       findings: full-param all-gathers (missed FSDP),
+                       all-reduces larger than the gradient tree,
+                       oversize replicated opt-state.
+
 Findings feed the SAME engine stack as the AST rules — ``Finding``
 objects, inline ``# graftlint: disable=`` suppressions (anchored on
 real source lines), the checked-in baseline, text/JSON reporters, and

@@ -52,6 +52,10 @@ class EntryPoint:
     # per-positional-arg placement tags for the sharding audit:
     # "state" | "batch" | "stack" | "repl" (see trace/sharding_audit.py)
     arg_specs: Tuple[str, ...] = ()
+    # explicit sharding contract override (parallel/contracts.Contract);
+    # None = look up by short name in contracts.ENTRY_CONTRACTS (the
+    # real catalog path) — fixtures inject their own here.
+    contract: Any = None
     # model compute dtype for this config ("float32" | "bfloat16") — the
     # dtype rule only hunts bf16→f32 upcasts when the model runs bf16.
     compute_dtype: str = "float32"
@@ -93,7 +97,8 @@ def register(cls: type) -> type:
 def all_trace_rules() -> List[type]:
     """Every registered trace rule class (imports the bundled set)."""
     from gansformer_tpu.analysis.trace import (  # noqa: F401  (registers)
-        const_bloat, dtype_flow, retrace, sharding_audit)
+        collective_flow, const_bloat, dtype_flow, partition_contract,
+        retrace, sharding_audit)
 
     return [_TRACE_REGISTRY[k] for k in sorted(_TRACE_REGISTRY)]
 
@@ -101,12 +106,22 @@ def all_trace_rules() -> List[type]:
 class TraceContext:
     """Shared per-run state: jaxpr cache, suppressions, findings."""
 
-    def __init__(self):
+    def __init__(self, mesh_sizes: Tuple[int, ...] = (2,)):
         self.findings: List[Finding] = []
         self._jaxprs: Dict[str, Any] = {}       # entry name -> ClosedJaxpr
         self._suppress_cache: Dict[str, tuple] = {}
         self._seen: set = set()
         self.notes: List[str] = []              # non-finding diagnostics
+        # graftcomms surface: the simulated-mesh device counts the
+        # contract/collective rules compile against (harness sets the
+        # full matrix for --trace-profile full), the shared compile
+        # cache (partition-contract and collective-flow compile the
+        # SAME entry×mesh programs — pay each compile once), and the
+        # accumulated comms-cost table (one record per entry×mesh).
+        self.mesh_sizes: Tuple[int, ...] = tuple(mesh_sizes)
+        self._compiled: Dict[Tuple[str, int], Any] = {}
+        self.comms: List[Dict[str, Any]] = []
+        self.meshes_compiled: set = set()       # sizes that ACTUALLY built
 
     # -- tracing -------------------------------------------------------------
 
@@ -123,6 +138,54 @@ class TraceContext:
                 fn = functools.partial(fn, **ep.static_kwargs)
             self._jaxprs[ep.name] = jax.make_jaxpr(fn)(*ep.abstract_args)
         return self._jaxprs[ep.name]
+
+    # -- contract-sharded compilation (graftcomms rules) ---------------------
+
+    def entry_contract(self, ep: EntryPoint):
+        """The entry's sharding contract: an injected override (fixtures)
+        or the catalog entry for its short name; None = undeclared."""
+        if ep.contract is not None:
+            return ep.contract
+        from gansformer_tpu.parallel.contracts import contract_for
+
+        return contract_for(ep.name)
+
+    def compiled(self, ep: EntryPoint, n_devices: int):
+        """``(compiled, out_leaf_infos)`` for the entry point compiled
+        with CONTRACT-sharded abstract inputs on an n×1 simulated mesh —
+        cached per (entry, mesh size) so the contract and collective
+        rules share one compile.  ``out_leaf_infos`` are the lowered
+        program's flattened per-output-leaf shape/dtype infos (captured
+        at lowering time: re-tracing outside the mesh context would
+        break bare-PartitionSpec constraints).  Raises on lowering/
+        compile failure (and caches the failure so the second rule
+        doesn't re-pay the attempt)."""
+        import jax
+
+        key = (ep.name, n_devices)
+        if key not in self._compiled:
+            from gansformer_tpu.parallel.contracts import (
+                sharded_abstract_args, simulated_mesh)
+
+            contract = self.entry_contract(ep)
+            if contract is None:
+                raise ValueError(f"{ep.name}: no sharding contract")
+            try:
+                env = simulated_mesh(n_devices)
+                args = sharded_abstract_args(contract, ep.abstract_args,
+                                             env)
+                with env.activate():
+                    lowered = ep.fn.lower(*args, **ep.static_kwargs)
+                    out_leaves = jax.tree_util.tree_flatten(
+                        lowered.out_info)[0]
+                    self._compiled[key] = (lowered.compile(), out_leaves)
+            except Exception as e:
+                self._compiled[key] = e
+        got = self._compiled[key]
+        if isinstance(got, Exception):
+            raise got
+        self.meshes_compiled.add(n_devices)
+        return got
 
     # -- suppression (same inline syntax as the AST engine) ------------------
 
@@ -265,6 +328,34 @@ def def_site(fn: Callable) -> Tuple[str, int]:
         return (path, line)
     except (OSError, TypeError):
         return ("<unknown>", 0)
+
+
+def leaf_bytes(aval) -> int:
+    """Best-effort byte size of an abstract leaf (0 when shapeless)."""
+    import numpy as np
+
+    try:
+        return int(np.prod(aval.shape) * np.dtype(aval.dtype).itemsize)
+    except Exception:
+        return 0
+
+
+def path_str(path) -> str:
+    """Human-readable pytree path (GetAttrKey/DictKey/SequenceKey)."""
+    out = []
+    for p in path:
+        out.append(str(getattr(p, "name", getattr(p, "key",
+                                                  getattr(p, "idx", p)))))
+    return "/".join(out)
+
+
+def shardings_equivalent(a, b, ndim: int) -> bool:
+    """Resolved-vs-intended sharding equivalence, tolerant of the
+    GSPMD/NamedSharding representation split (string fallback)."""
+    try:
+        return bool(a.is_equivalent_to(b, ndim))
+    except Exception:
+        return str(a) == str(b)
 
 
 def sizeof(const) -> int:

@@ -1,0 +1,335 @@
+"""collective-flow — collective inventory + comms-cost attribution.
+
+Walks the compiled HLO of every contract-covered entry point (on the
+same simulated mesh matrix as ``partition-contract``; the compile is
+shared via ``ctx.compiled``) for ``all-gather`` / ``all-reduce`` /
+``reduce-scatter`` / ``collective-permute`` ops, attributes bytes
+moved per collective per entry point, and accumulates the ranked
+comms-cost table in ``ctx.comms`` — the comms twin of
+``bench_components.py``'s per-op FLOP attribution (exported via
+``gansformer-lint --json-out``).
+
+Three anti-patterns become findings:
+
+* **full-param all-gather** — a single all-gather whose payload covers
+  most of the params-role input bytes: the program re-materializes the
+  full parameter tree every step, i.e. params were sharded (FSDP) but
+  the compute never consumes them sharded, so the sharding bought
+  memory but the step pays a full gather (the missed-FSDP pattern).
+* **oversized all-reduce** — an all-reduce moving more bytes than the
+  whole params tree: data-parallel training only ever all-reduces
+  gradients (≤ params bytes) and scalar stats, so anything bigger is
+  an activation reduction that should have stayed device-local.
+* **replicated opt-state** — an opt-state-role input leaf above a size
+  threshold resolving fully replicated: every chip holds a full copy
+  of Adam moments that FSDP would shard for free.
+
+Byte accounting: ``payload`` is the logical tensor moved (the HLO
+result shape; for reduce-scatter, result × group).  ``wire`` is the
+per-device ring-algorithm traffic — all-reduce ``2·N·(g-1)/g``,
+all-gather / reduce-scatter ``N·(g-1)/g``, collective-permute ``N``.
+Counts are per program TEXT: a collective inside a ``scan`` body is
+counted once, not trip-count times (the table is a per-dispatch lower
+bound for the fused cycle — noted in the record).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Sequence
+
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, TraceRule, leaf_bytes, path_str, register)
+
+FULL_GATHER_MIN_BYTES = 256 * 1024
+FULL_GATHER_PARAM_FRACTION = 0.5
+OVERSIZED_ALLREDUCE_MIN_BYTES = 1024 * 1024
+OPT_REPLICATED_THRESHOLD_BYTES = 4 * 1024 * 1024
+
+COLLECTIVE_KINDS = ("all-gather", "all-reduce", "reduce-scatter",
+                    "collective-permute")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# `%x = f32[8,4]{1,0} all-gather(...)` / `(f32[4], f32[8]) all-reduce(...)`
+# — definitions only (result type right before the op name); async
+# `-start` forms count, their `-done` halves don't (same transfer).
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+"
+    r"(all-gather|all-reduce|reduce-scatter|collective-permute)"
+    r"(-start)?\(")
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+
+
+def _shape_bytes_list(type_str: str) -> List[int]:
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append(n * _DTYPE_BYTES.get(dtype, 4))
+    return out
+
+
+def _group_size(line: str, default: int) -> int:
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        return max(1, int(m.group(2)))
+    m = _GROUPS_LIST_RE.search(line)
+    if m:
+        return max(1, len([x for x in m.group(1).split(",") if x.strip()]))
+    return default
+
+
+def wire_bytes(kind: str, payload: int, group: int) -> int:
+    """Per-device ring-traffic model for one collective."""
+    if group <= 1:
+        return 0
+    if kind == "all-reduce":
+        return int(2 * payload * (group - 1) / group)
+    if kind in ("all-gather", "reduce-scatter"):
+        return int(payload * (group - 1) / group)
+    return int(payload)       # collective-permute
+
+
+def parse_collectives(hlo_text: str, default_group: int
+                      ) -> List[Dict[str, Any]]:
+    """Collective op inventory of one compiled module's HLO text:
+    ``{kind, payload_bytes, wire_bytes_per_device, group}`` per op."""
+    out: List[Dict[str, Any]] = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        type_str, kind, is_start = m.group(1), m.group(2), bool(m.group(3))
+        group = _group_size(line, default_group)
+        shapes = _shape_bytes_list(type_str)
+        if is_start and kind != "all-reduce":
+            # async bundle results carry (operand, result[, context]):
+            # the transferred tensor is the LARGEST element — for
+            # all-gather(-start) the full output, for reduce-scatter the
+            # full input (already whole: no ×group below), for
+            # collective-permute the tensor itself.  Summing the bundle
+            # would double-count the operand.
+            payload = max(shapes, default=0)
+        else:
+            payload = sum(shapes)
+            if kind == "reduce-scatter":
+                payload *= group      # result is the shard; move the whole
+        out.append({"kind": kind, "payload_bytes": payload,
+                    "wire_bytes_per_device": wire_bytes(kind, payload,
+                                                        group),
+                    "group": group})
+    return out
+
+
+def _role_bytes(contract, abstract_args) -> Dict[str, int]:
+    import jax
+
+    from gansformer_tpu.parallel.contracts import arg_leaf_contracts
+
+    totals: Dict[str, int] = {}
+    flat = arg_leaf_contracts(contract, abstract_args)
+    leaves = [l for _, l in
+              jax.tree_util.tree_flatten_with_path(abstract_args)[0]]
+    for (argi, path, role, spec), aval in zip(flat, leaves):
+        totals[role] = totals.get(role, 0) + leaf_bytes(aval)
+    return totals
+
+
+def comms_record(ep_name: str, n_devices: int, ops: List[Dict[str, Any]],
+                 role_bytes: Dict[str, int]) -> Dict[str, Any]:
+    """One ctx.comms entry: per-kind aggregation + totals for one
+    entry×mesh compile (pure — unit-tested on synthetic inventories)."""
+    by_kind: Dict[str, Dict[str, int]] = {}
+    for op in ops:
+        agg = by_kind.setdefault(op["kind"], {"count": 0,
+                                              "payload_bytes": 0,
+                                              "wire_bytes_per_device": 0})
+        agg["count"] += 1
+        agg["payload_bytes"] += op["payload_bytes"]
+        agg["wire_bytes_per_device"] += op["wire_bytes_per_device"]
+    return {
+        "entry": ep_name,
+        "devices": n_devices,
+        "collectives": by_kind,
+        "total_payload_bytes": sum(a["payload_bytes"]
+                                   for a in by_kind.values()),
+        "total_wire_bytes_per_device": sum(
+            a["wire_bytes_per_device"] for a in by_kind.values()),
+        "param_bytes": role_bytes.get("params", 0),
+        "opt_state_bytes": role_bytes.get("opt_state", 0),
+        "note": "static per-dispatch inventory; scan-body collectives "
+                "counted once",
+    }
+
+
+def ranked_comms_table(comms: Sequence[Dict[str, Any]]
+                       ) -> List[Dict[str, Any]]:
+    """Per-entry ranked table (largest simulated mesh wins per entry,
+    ranked by per-device wire bytes descending) — the ``--json-out`` /
+    ``--format json`` payload."""
+    best: Dict[str, Dict[str, Any]] = {}
+    for rec in comms:
+        cur = best.get(rec["entry"])
+        if cur is None or rec["devices"] > cur["devices"]:
+            best[rec["entry"]] = rec
+    return sorted(best.values(),
+                  key=lambda r: (-r["total_wire_bytes_per_device"],
+                                 r["entry"]))
+
+
+def scaling_report(comms: Sequence[Dict[str, Any]],
+                   chip_counts: Sequence[int] = (1, 2, 4, 8, 16, 32, 64)
+                   ) -> Dict[str, Dict[str, int]]:
+    """Predicted per-device wire bytes per dispatch vs chip count.
+
+    Collective payloads in this layout are chip-count-INDEPENDENT (the
+    gradient tree / gathered params don't grow with the mesh), so the
+    ring model extrapolates each kind's aggregate payload measured on
+    the largest simulated mesh: the all-reduce term approaches 2·N —
+    which is exactly why DP scaling efficiency flattens, and what
+    ``bench.py`` turns into an expected-efficiency curve before any
+    multi-chip hardware exists."""
+    out: Dict[str, Dict[str, int]] = {}
+    for rec in ranked_comms_table(comms):
+        per_chip: Dict[str, int] = {}
+        for c in chip_counts:
+            total = 0
+            for kind, agg in rec["collectives"].items():
+                total += wire_bytes(kind, agg["payload_bytes"], c)
+            per_chip[str(c)] = total
+        out[rec["entry"]] = per_chip
+    return out
+
+
+def scaling_efficiency(wire_bytes_per_device: int, step_s: float,
+                       ici_bytes_per_s: float) -> float:
+    """No-overlap serial model: eff = t_comp / (t_comp + t_comms).
+    Pessimistic by design (XLA overlaps collectives with compute when
+    it can) — a floor, not a forecast."""
+    if step_s <= 0 or ici_bytes_per_s <= 0:
+        return 0.0
+    return step_s / (step_s + wire_bytes_per_device / ici_bytes_per_s)
+
+
+@register
+class CollectiveFlowRule(TraceRule):
+    id = "collective-flow"
+    description = ("collective anti-pattern in the compiled SPMD "
+                   "program: full-param all-gather (missed FSDP), "
+                   "all-reduce larger than the gradient tree, or "
+                   "oversize fully-replicated opt-state")
+    hint = ("consume params sharded (or revert the sharding), keep "
+            "reductions device-local until the gradient psum, and "
+            "shard optimizer moments alongside their params")
+    dynamic = True
+
+    full_gather_min = FULL_GATHER_MIN_BYTES
+    full_gather_fraction = FULL_GATHER_PARAM_FRACTION
+    oversized_allreduce_min = OVERSIZED_ALLREDUCE_MIN_BYTES
+    opt_replicated_threshold = OPT_REPLICATED_THRESHOLD_BYTES
+
+    def check(self, ep: EntryPoint, ctx: TraceContext) -> None:
+        import jax
+
+        contract = ctx.entry_contract(ep)
+        if contract is None:
+            ctx.notes.append(f"{ep.name}: no sharding contract declared; "
+                             f"collective-flow skipped")
+            return
+        role_bytes = _role_bytes(contract, ep.abstract_args)
+        n_local = len(jax.devices())
+        for n in ctx.mesh_sizes:
+            if n > n_local:
+                ctx.notes.append(
+                    f"{ep.name}: {n}-device mesh needs "
+                    f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                    f"{n} (have {n_local}); collective-flow skipped")
+                continue
+            try:
+                compiled, _out = ctx.compiled(ep, n)
+                hlo = compiled.as_text()
+            except Exception as e:
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: compile/HLO read failed on the "
+                           f"{n}-device mesh: {type(e).__name__}: "
+                           f"{str(e)[:160]}")
+                continue
+            ops = parse_collectives(hlo, default_group=n)
+            ctx.comms.append(comms_record(ep.name, n, ops, role_bytes))
+            if n > 1:        # a 1-device program has no collectives
+                self._flag_anti_patterns(ep, ctx, ops, role_bytes,
+                                         compiled, contract)
+
+    # -- anti-patterns -------------------------------------------------------
+
+    def _flag_anti_patterns(self, ep, ctx, ops, role_bytes, compiled,
+                            contract) -> None:
+        param_bytes = role_bytes.get("params", 0)
+        for op in ops:
+            if (op["kind"] == "all-gather"
+                    and op["payload_bytes"] >= self.full_gather_min
+                    and param_bytes > 0
+                    and op["payload_bytes"] >=
+                    self.full_gather_fraction * param_bytes):
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: full-param all-gather — one "
+                           f"all-gather moves "
+                           f"{op['payload_bytes'] / 2**20:.1f} MiB "
+                           f"(params total "
+                           f"{param_bytes / 2**20:.1f} MiB): the step "
+                           f"re-materializes the sharded tree every "
+                           f"dispatch (missed FSDP)")
+            if (op["kind"] == "all-reduce"
+                    and op["payload_bytes"] >= self.oversized_allreduce_min
+                    and op["payload_bytes"] > param_bytes):
+                # param_bytes sums the whole params role (G + D + EMA)
+                # — a deliberately GENEROUS upper bound on any single
+                # step's gradient tree, so what crosses it is an
+                # activation reduction beyond doubt
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: all-reduce of "
+                           f"{op['payload_bytes'] / 2**20:.1f} MiB "
+                           f"exceeds the TOTAL params bytes "
+                           f"({param_bytes / 2**20:.1f} MiB, itself an "
+                           f"upper bound on any gradient tree) — an "
+                           f"activation-sized reduction that should "
+                           f"stay device-local")
+        self._flag_replicated_opt_state(ep, ctx, compiled, contract)
+
+    def _flag_replicated_opt_state(self, ep, ctx, compiled,
+                                   contract) -> None:
+        import jax
+
+        from gansformer_tpu.parallel.contracts import arg_leaf_contracts
+
+        leaf_info = arg_leaf_contracts(contract, ep.abstract_args)
+        flat_in, _ = jax.tree_util.tree_flatten(
+            compiled.input_shardings[0])
+        leaves = [l for _, l in jax.tree_util.tree_flatten_with_path(
+            ep.abstract_args)[0]]
+        if len(flat_in) != len(leaf_info):
+            return
+        for (argi, path, role, spec), aval, resolved in zip(
+                leaf_info, leaves, flat_in):
+            if role != "opt_state" or not hasattr(aval, "shape"):
+                continue
+            n = leaf_bytes(aval)
+            if n < self.opt_replicated_threshold:
+                continue
+            if getattr(resolved, "is_fully_replicated", False):
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: opt-state leaf "
+                           f"arg{argi}/{path_str(path)} "
+                           f"({n / 2**20:.1f} MiB) is fully replicated "
+                           f"— every device holds a full copy of "
+                           f"optimizer moments FSDP would shard")

@@ -133,6 +133,23 @@ def build_entry_points(config_name: str,
             static_kwargs=None, train_step=False, arg_specs=()):
         if include is not None and short not in include:
             return
+        # Loud coverage contract: every entry must carry a complete
+        # per-arg placement tag set AND a declared PartitionSpec
+        # contract — the audits' "skipped" note paths exist for
+        # FIXTURES, not for the real catalog (they silently exempted
+        # the inference programs the serving path will reuse).
+        from gansformer_tpu.parallel.contracts import contract_for
+
+        if len(arg_specs) != len(abstract_args):
+            raise ValueError(
+                f"entry point {short!r}: {len(arg_specs)} arg_specs for "
+                f"{len(abstract_args)} args — the sharding audit would "
+                f"silently skip it")
+        if contract_for(short) is None:
+            raise ValueError(
+                f"entry point {short!r}: no sharding contract in "
+                f"parallel/contracts.ENTRY_CONTRACTS — declare the "
+                f"intended PartitionSpecs before adding the entry")
         path, line = def_site(fn)
         eps.append(EntryPoint(
             name=f"steps.{short}[{config_name}]", fn=fn,

@@ -3,15 +3,23 @@
 Profiles bound the cost (the structural rules only *trace* — Python
 speed; the dynamic rules *execute/compile* — XLA speed):
 
-* ``fast`` — structural rules over the whole fast matrix; the retrace
-  probe on the plain train-step pair (``d_step``/``g_step``) and the
-  sharding audit on ``d_step``, all on the f32 reference config.  This
-  is the tier-1 / ``--selfcheck`` budget (<~1 min cold, mostly cached
-  on re-runs via the persistent compile cache).
-* ``full`` — every rule over every entry point of every matrix config
-  (the ``slow``-marked test and explicit ``--trace-profile full`` runs).
 * ``structural`` — tracing only; never compiles or executes.  Safe in
   any process (no device-count or cache side effects).
+* ``contracts`` — structural plus the ``partition-contract`` check on
+  the four train-step programs (2-device simulated mesh).  This is the
+  ``--selfcheck`` / pre-commit budget: one contract-sharded compile per
+  train step, mostly cached on re-runs via the persistent compile
+  cache.
+* ``fast`` — contracts plus the retrace probe on the plain train-step
+  pair (``d_step``/``g_step``) and the sharding/collective audits on
+  all four train-step programs, all on the f32 reference config and
+  the 2-device mesh.
+* ``full`` — every rule over every entry point of every matrix config,
+  with the graftcomms pair (partition-contract, collective-flow) run
+  across the whole simulated mesh matrix (1/2/4 devices —
+  ``parallel/contracts.MESH_MATRIX``; sharding-audit keeps its legacy
+  fixed 2-device mesh).  The ``slow``-marked test and explicit
+  ``--trace-profile full`` runs.
 """
 
 from __future__ import annotations
@@ -23,33 +31,65 @@ from gansformer_tpu.analysis.trace.base import (
     EntryPoint, TraceContext, all_trace_rules)
 from gansformer_tpu.analysis.trace.entry_points import build_matrix
 
-PROFILES = ("structural", "fast", "full")
+PROFILES = ("structural", "contracts", "fast", "full")
 
 # fast-profile dynamic surface (see module docstring)
 _FAST_RETRACE = ("steps.d_step[tiny-f32]", "steps.g_step[tiny-f32]")
-_FAST_SHARDING = ("steps.d_step[tiny-f32]",)
+# ALL FOUR train-step programs: a sharding/contract regression in the
+# reg variants (the second-order programs with the heaviest layouts)
+# must not hide behind a d_step-only fast probe.
+_FAST_MESH = ("steps.d_step[tiny-f32]", "steps.d_step_r1[tiny-f32]",
+              "steps.g_step[tiny-f32]", "steps.g_step_pl[tiny-f32]")
+# the rules that lower+compile on the simulated mesh matrix
+_MESH_RULES = ("sharding-audit", "partition-contract", "collective-flow")
 
 
 def _dynamic_entries(rule_id: str, profile: str,
                      entries: List[EntryPoint]) -> List[EntryPoint]:
     if profile == "structural":
         return []
+    if profile == "contracts":
+        if rule_id == "partition-contract":
+            return [ep for ep in entries if ep.name in _FAST_MESH]
+        return []
     if profile == "full":
         if rule_id == "sharding-audit":
             return [ep for ep in entries if ep.arg_specs]
+        if rule_id in ("partition-contract", "collective-flow"):
+            # Sharding/collective STRUCTURE is dtype-independent: the
+            # bf16 matrix member exists for dtype flow, and re-compiling
+            # its programs across the whole mesh matrix would double the
+            # cost for zero new layout coverage.  Fixture entries carry
+            # no config_name and pass through.
+            return [ep for ep in entries
+                    if ep.config_name in ("", "tiny-f32")]
         return entries
-    wanted = _FAST_SHARDING if rule_id == "sharding-audit" else _FAST_RETRACE
+    wanted = _FAST_MESH if rule_id in _MESH_RULES else _FAST_RETRACE
     return [ep for ep in entries if ep.name in wanted]
+
+
+def mesh_sizes_for(profile: str) -> Tuple[int, ...]:
+    """Simulated-mesh device counts for the mesh-compiling rules: the
+    full matrix only under ``full`` (3× the compiles), the cheap
+    2-device mesh everywhere else."""
+    if profile == "full":
+        from gansformer_tpu.parallel.contracts import MESH_MATRIX
+
+        return MESH_MATRIX
+    return (2,)
 
 
 def run_trace(profile: str = "fast",
               rules: Optional[Iterable[type]] = None,
-              entries: Optional[List[EntryPoint]] = None
+              entries: Optional[List[EntryPoint]] = None,
+              mesh_sizes: Optional[Tuple[int, ...]] = None
               ) -> Tuple[List[Finding], TraceContext]:
     """Run the trace rules; returns (findings, context).  ``entries``
     overrides the built-in matrix (tests inject fixtures this way) —
     with an override, profile only selects structural vs dynamic, not
-    which entries the dynamic rules see."""
+    which entries the dynamic rules see.  ``mesh_sizes`` overrides the
+    profile's simulated-mesh matrix; the context carries the
+    accumulated comms-cost table (``ctx.comms``)."""
     if profile not in PROFILES:
         raise ValueError(f"unknown trace profile {profile!r}; "
                          f"have {PROFILES}")
@@ -66,7 +106,8 @@ def run_trace(profile: str = "fast",
                 "full" if profile == "full" else "fast"))
         return built[0]
 
-    ctx = TraceContext()
+    ctx = TraceContext(mesh_sizes=mesh_sizes if mesh_sizes is not None
+                       else mesh_sizes_for(profile))
     for cls in rule_classes:
         rule = cls()
         if rule.dynamic:

@@ -1,0 +1,140 @@
+"""partition-contract — resolved shardings vs. the DECLARED intent.
+
+``sharding-audit`` (the seedling this grew from) flags two generic
+pathologies; this rule asserts the repo's actual layout design:
+``parallel/contracts.py`` declares the intended ``PartitionSpec`` per
+logical arg role (params, opt-state leaves, batch, rng) per entry
+point, the harness lowers+compiles every entry on a simulated mesh
+matrix (1/2/4 CPU devices via ``--xla_force_host_platform_device_
+count``), and any resolved input, output, or donated-leaf sharding
+that deviates from the contract is a finding.
+
+Why compile instead of just reading the annotations: GSPMD propagates
+shardings through the whole program, so an innocent-looking
+``with_sharding_constraint`` (or a missing one) can silently re-shard
+a donated state leaf or pin an output to a layout the loop never
+intended — only the *compiled* program's resolved shardings tell the
+truth.  Deviation on a DONATED leaf is double trouble: the intent is
+broken AND XLA must copy instead of aliasing (same failure the
+donation half of sharding-audit watches, here attributed to the
+declared contract).
+"""
+
+from __future__ import annotations
+
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, TraceRule, leaf_bytes, path_str, register,
+    shardings_equivalent)
+
+
+def _spec_str(sharding) -> str:
+    """Compact resolved-sharding rendering: the spec when one exists
+    (NamedSharding), else the full repr (GSPMD/op shardings)."""
+    spec = getattr(sharding, "spec", None)
+    return str(spec) if spec is not None else str(sharding)
+
+
+@register
+class PartitionContractRule(TraceRule):
+    id = "partition-contract"
+    description = ("compiled sharding deviates from the declared "
+                   "PartitionSpec contract (parallel/contracts.py) for "
+                   "an input, output, or donated leaf")
+    hint = ("make the program resolve the declared spec (fix the "
+            "constraint / input sharding), or change the contract in "
+            "parallel/contracts.py if the new layout is intended")
+    dynamic = True
+
+    def check(self, ep: EntryPoint, ctx: TraceContext) -> None:
+        import jax
+        from jax.sharding import NamedSharding
+
+        from gansformer_tpu.parallel.contracts import simulated_mesh
+
+        contract = ctx.entry_contract(ep)
+        if contract is None:
+            ctx.notes.append(f"{ep.name}: no sharding contract declared "
+                             f"(parallel/contracts.ENTRY_CONTRACTS); "
+                             f"partition-contract skipped")
+            return
+        n_local = len(jax.devices())
+        for n in ctx.mesh_sizes:
+            if n > n_local:
+                ctx.notes.append(
+                    f"{ep.name}: {n}-device mesh needs "
+                    f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                    f"{n} (have {n_local}); skipped")
+                continue
+            try:
+                compiled, out_avals = ctx.compiled(ep, n)
+            except Exception as e:
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: contract-sharded lowering failed "
+                           f"on the {n}-device mesh: {type(e).__name__}: "
+                           f"{str(e)[:160]}")
+                continue
+            self._check_one(ep, ctx, contract, compiled, out_avals,
+                            simulated_mesh(n), NamedSharding)
+
+    def _check_one(self, ep, ctx, contract, compiled, out_avals, env,
+                   NamedSharding) -> None:
+        import jax
+
+        from gansformer_tpu.parallel.contracts import (
+            arg_leaf_contracts, out_leaf_contracts)
+
+        # -- inputs ----------------------------------------------------------
+        leaf_info = arg_leaf_contracts(contract, ep.abstract_args)
+        flat_in, _ = jax.tree_util.tree_flatten(compiled.input_shardings[0])
+        in_leaves = [l for _, l in
+                     jax.tree_util.tree_flatten_with_path(
+                         ep.abstract_args)[0]]
+        if len(flat_in) != len(leaf_info) or len(in_leaves) != len(flat_in):
+            ctx.notes.append(f"{ep.name}: input arity mismatch vs "
+                             f"contract ({len(flat_in)} resolved, "
+                             f"{len(leaf_info)} declared); skipped")
+            return
+        donated = set(ep.donate_argnums)
+        for (argi, path, role, spec), aval, resolved in zip(
+                leaf_info, in_leaves, flat_in):
+            if spec is None or not hasattr(aval, "shape"):
+                continue
+            intended = NamedSharding(env.mesh, spec)
+            if not shardings_equivalent(resolved, intended,
+                                        len(aval.shape)):
+                where = "donated input" if argi in donated else "input"
+                self._dedup_report(
+                    ctx, ep,
+                    f"{where} arg{argi}/{path_str(path)} (role {role}, "
+                    f"{leaf_bytes(aval)} B) resolves "
+                    f"{_spec_str(resolved)}, contract says {spec}")
+
+        # -- outputs (incl. the donated state's returned leaves) -------------
+        flat_out, _ = jax.tree_util.tree_flatten(compiled.output_shardings)
+        out_info = out_leaf_contracts(contract, ep.abstract_args,
+                                      len(flat_out))
+        if len(out_avals) != len(flat_out):
+            ctx.notes.append(f"{ep.name}: output arity mismatch "
+                             f"({len(flat_out)} shardings, "
+                             f"{len(out_avals)} avals); output contract "
+                             f"check skipped")
+            return
+        for (label, role, spec), aval, resolved in zip(
+                out_info, out_avals, flat_out):
+            if spec is None or not hasattr(aval, "shape"):
+                continue
+            intended = NamedSharding(env.mesh, spec)
+            if not shardings_equivalent(resolved, intended,
+                                        len(aval.shape)):
+                kind = ("donated-leaf output" if label.startswith("state:")
+                        and 0 in set(ep.donate_argnums) else "output")
+                self._dedup_report(
+                    ctx, ep,
+                    f"{kind} {label} (role {role}) resolves "
+                    f"{_spec_str(resolved)}, contract says {spec}")
+
+    def _dedup_report(self, ctx, ep, detail: str) -> None:
+        # Message carries no mesh size: the same deviation usually
+        # reproduces on every mesh, and a mesh-tagged message would
+        # triple every baseline entry under --trace-profile full.
+        ctx.report(self, ep.anchor, f"{ep.name}: {detail}")

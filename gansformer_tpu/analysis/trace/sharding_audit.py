@@ -23,26 +23,10 @@ from __future__ import annotations
 from typing import Any, Optional, Tuple
 
 from gansformer_tpu.analysis.trace.base import (
-    EntryPoint, TraceContext, TraceRule, register)
+    EntryPoint, TraceContext, TraceRule, leaf_bytes as _leaf_bytes,
+    path_str as _path_str, register, shardings_equivalent)
 
 REPLICATED_THRESHOLD_BYTES = 8 * 1024 * 1024
-
-
-def _leaf_bytes(aval) -> int:
-    import numpy as np
-
-    try:
-        return int(np.prod(aval.shape) * np.dtype(aval.dtype).itemsize)
-    except Exception:
-        return 0
-
-
-def _path_str(path) -> str:
-    out = []
-    for p in path:
-        out.append(str(getattr(p, "name", getattr(p, "key",
-                                                  getattr(p, "idx", p)))))
-    return "/".join(out)
 
 
 def make_sharded_args(ep: EntryPoint, env) -> Optional[Tuple[Any, ...]]:
@@ -76,11 +60,7 @@ def make_sharded_args(ep: EntryPoint, env) -> Optional[Tuple[Any, ...]]:
     return tuple(out)
 
 
-def _equivalent(a, b, ndim: int) -> bool:
-    try:
-        return bool(a.is_equivalent_to(b, ndim))
-    except Exception:
-        return str(a) == str(b)
+_equivalent = shardings_equivalent
 
 
 @register

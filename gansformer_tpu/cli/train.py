@@ -96,10 +96,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="synchronous checkpoint/snapshot writes on the "
                         "loop thread (parity fallback)")
     p.add_argument("--selfcheck", action="store_true",
-                   help="run graftlint (AST + jaxpr trace rules) before "
-                        "training; writes <run_dir>/graftlint.json and "
-                        "aborts on NEW findings — catch a retrace storm "
-                        "or dtype leak before it burns accelerator hours")
+                   help="run graftlint (AST rules + structural jaxpr "
+                        "trace + the PartitionSpec-contract check on the "
+                        "four train steps) before training; writes "
+                        "<run_dir>/graftlint.json and aborts on NEW "
+                        "findings — catch a dtype leak or a "
+                        "mis-partitioned step before it burns "
+                        "accelerator hours")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans + per-tick finite checks")
     p.add_argument("--profile-dir", default=None,

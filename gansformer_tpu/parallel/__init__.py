@@ -6,3 +6,11 @@ from gansformer_tpu.parallel.mesh import (
     init_distributed,
     local_batch_size,
 )
+from gansformer_tpu.parallel.contracts import (  # noqa: F401
+    Contract,
+    ENTRY_CONTRACTS,
+    MESH_MATRIX,
+    ROLE_SPECS,
+    contract_for,
+    simulated_mesh,
+)

@@ -1,0 +1,251 @@
+"""Sharding contracts — the INTENDED PartitionSpec per logical arg role.
+
+``parallel/mesh.py`` hands out shardings; this module declares which
+sharding each entry point's inputs and outputs are *supposed* to
+resolve to, so the graftcomms analysis layer (``analysis/trace/
+partition_contract.py`` / ``collective_flow.py``) can prove the
+compiled SPMD programs are partitioned as designed — before a rare TPU
+window burns minutes discovering an accidental all-gather.
+
+The contract is deliberately small: a role vocabulary (params,
+opt-state, batch, rng, …), one intended ``PartitionSpec`` per role, and
+a per-entry-point table mapping positional args (and output leaves) to
+roles.  Today every role except the batch family is replicated — the
+repo's layout is pure data parallelism — so the value of writing it
+down is that a future FSDP/tensor-parallel axis changes ONE table here
+and the whole analysis stack starts asserting the new intent on every
+step program (ROADMAP item 2).
+
+Roles:
+  ``params``      G/D/EMA parameter leaves — replicated (DP today; the
+                  FSDP hook is flipping this spec to shard over a mesh
+                  axis).
+  ``opt_state``   optax moment leaves — wherever params go, these go.
+  ``stat``        small replicated scalars/vectors (step, w_avg,
+                  pl_mean, aux metrics).
+  ``batch``       per-example arrays, leading axis over ``data``.
+  ``batch_stack`` [K, B, ...] fused-cycle input stacks: axis 1 over
+                  ``data`` (``MeshEnv.batch_stack``).
+  ``rng``         PRNG keys — replicated (every device folds the same
+                  stream; per-device divergence would break the fused/
+                  unfused parity contract in tests/test_train.py).
+  ``scalar``      python scalars at the jit boundary (no sharding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from gansformer_tpu.parallel.mesh import DATA_AXIS, MeshEnv, make_mesh
+
+# The simulated mesh matrix the contract/collective analyses compile
+# against (CPU devices via --xla_force_host_platform_device_count).
+# 1 catches degenerate-axis lowering breaks, 2 is the cheap default,
+# and the 4-device member is a 2×2 data×model grid: the tiny trace
+# batch (2) bounds the data axis, and the reserved model axis is
+# exactly the hook a future FSDP/TP layout flips — compiling with it
+# non-trivial proves the programs tolerate an idle second axis.
+MESH_MATRIX: Tuple[int, ...] = (1, 2, 4)
+_MESH_SHAPES: Dict[int, Tuple[int, int]] = {1: (1, 1), 2: (2, 1),
+                                            4: (2, 2)}
+
+ROLE_SPECS: Dict[str, Optional[P]] = {
+    "params": P(),
+    "opt_state": P(),
+    "stat": P(),
+    "rng": P(),
+    "batch": P(DATA_AXIS),
+    "batch_stack": P(None, DATA_AXIS),
+    "scalar": None,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """Intended placement for one entry point.
+
+    ``args``: one role per positional arg; the special role ``"state"``
+    expands per-leaf via ``state_leaf_role`` (the TrainState pytree
+    mixes params/opt-state/stat leaves).  ``outs``: role assignment for
+    the flattened outputs — ``"state"`` consumes the donated state's
+    leaves (same treedef: the steps return ``state.replace(...)``), and
+    the LAST entry soaks up every remaining leaf.  ``role_specs``
+    overrides ``ROLE_SPECS`` per entry (the FSDP pilot / fixture hook).
+    """
+
+    args: Tuple[str, ...]
+    outs: Tuple[str, ...]
+    role_specs: Optional[Mapping[str, Optional[P]]] = None
+
+    def spec_for(self, role: str) -> Optional[P]:
+        if self.role_specs is not None and role in self.role_specs:
+            return self.role_specs[role]
+        if role not in ROLE_SPECS:
+            raise KeyError(f"unknown contract role {role!r}; "
+                           f"have {sorted(ROLE_SPECS)}")
+        return ROLE_SPECS[role]
+
+
+_TRAIN_STEP = Contract(args=("state", "batch", "rng"),
+                       outs=("state", "stat"))
+_G_STEP = Contract(args=("state", "rng"), outs=("state", "stat"))
+
+# One entry per jitted program in analysis/trace/entry_points.py —
+# keyed by the short name ("steps.<short>[config]").  A new entry point
+# without a contract is a loud skip-note in the analysis, not a silent
+# pass (the pre-graftcomms audit silently exempted spec-less entries).
+ENTRY_CONTRACTS: Dict[str, Contract] = {
+    "d_step": _TRAIN_STEP,
+    "d_step_r1": _TRAIN_STEP,
+    "g_step": _G_STEP,
+    "g_step_pl": _G_STEP,
+    "cycle": Contract(args=("state", "batch_stack", "rng", "scalar"),
+                      outs=("state", "stat")),
+    # Inference programs the serving path (ROADMAP item 3) will reuse:
+    # sample(ema_params, w_avg, z, rng) and ppl_pairs(params, z0, z1,
+    # t, rng) — params replicated, per-example arrays on ``data``.
+    "sample": Contract(args=("params", "stat", "batch", "rng"),
+                       outs=("batch",)),
+    "ppl_pairs": Contract(args=("params", "batch", "batch", "batch",
+                                "rng"),
+                          outs=("batch",)),
+}
+
+
+def short_entry_name(name: str) -> str:
+    """"steps.d_step[tiny-f32]" → "d_step" (fixture names pass through
+    unchanged when they don't follow the catalog convention)."""
+    tail = name.split(".", 1)[1] if "." in name else name
+    return tail.split("[", 1)[0]
+
+
+def contract_for(name: str) -> Optional[Contract]:
+    return ENTRY_CONTRACTS.get(short_entry_name(name))
+
+
+def key_str(entry: Any) -> str:
+    """One pytree path entry (GetAttrKey/DictKey/SequenceKey) → its
+    name — THE key-rendering helper (state_leaf_role and the output
+    labels both go through it, so a new key type is a one-line fix)."""
+    return str(getattr(entry, "name", getattr(entry, "key",
+                                              getattr(entry, "idx",
+                                                      entry))))
+
+
+def state_leaf_role(path: Sequence[Any]) -> str:
+    """TrainState leaf path → role, keyed on the dataclass field name
+    (train/state.py: g_params/d_params/ema_params are parameter trees,
+    g_opt/d_opt optimizer moments, the rest replicated stats)."""
+    head = key_str(path[0]) if path else ""
+    if head in ("g_params", "d_params", "ema_params"):
+        return "params"
+    if head in ("g_opt", "d_opt"):
+        return "opt_state"
+    return "stat"
+
+
+def _flatten_with_paths(tree):
+    import jax
+
+    return jax.tree_util.tree_flatten_with_path(tree)[0]
+
+
+def arg_leaf_contracts(contract: Contract, abstract_args: Tuple[Any, ...]
+                       ) -> List[Tuple[int, Tuple, str, Optional[P]]]:
+    """Flattened input-leaf view of the contract, aligned with
+    ``jax.tree_util.tree_flatten(abstract_args)`` order: one
+    ``(arg_index, path, role, intended_spec)`` per leaf."""
+    if len(contract.args) != len(abstract_args):
+        raise ValueError(
+            f"contract declares {len(contract.args)} args but the entry "
+            f"point has {len(abstract_args)}")
+    out: List[Tuple[int, Tuple, str, Optional[P]]] = []
+    for i, (role, arg) in enumerate(zip(contract.args, abstract_args)):
+        for path, leaf in _flatten_with_paths(arg):
+            leaf_role = state_leaf_role(path) if role == "state" else role
+            spec = (None if not hasattr(leaf, "shape")
+                    else contract.spec_for(leaf_role))
+            out.append((i, tuple(path), leaf_role, spec))
+    return out
+
+
+def out_leaf_contracts(contract: Contract, abstract_args: Tuple[Any, ...],
+                       n_out_leaves: int
+                       ) -> List[Tuple[str, str, Optional[P]]]:
+    """Role + intended spec per flattened OUTPUT leaf: ``"state"`` in
+    ``outs`` consumes the arg-0 state's leaves (donated; same treedef —
+    the steps return ``state.replace(...)`` first), then the final role
+    covers every remaining leaf (the aux/metric tail)."""
+    out: List[Tuple[str, str, Optional[P]]] = []
+    if contract.outs and contract.outs[0] == "state":
+        for path, leaf in _flatten_with_paths(abstract_args[0]):
+            leaf_role = state_leaf_role(path)
+            label = "/".join(key_str(p) for p in path)
+            out.append((f"state:{label}", leaf_role,
+                        contract.spec_for(leaf_role)))
+    tail_role = contract.outs[-1]
+    if tail_role == "state":        # outs == ("state",): no aux tail
+        tail_role = "stat"
+    while len(out) < n_out_leaves:
+        out.append((f"out[{len(out)}]", tail_role,
+                    contract.spec_for(tail_role)))
+    return out[:n_out_leaves]
+
+
+def sharded_abstract_args(contract: Contract,
+                          abstract_args: Tuple[Any, ...],
+                          env: MeshEnv) -> Tuple[Any, ...]:
+    """``abstract_args`` re-annotated with the CONTRACT's intended
+    shardings on ``env``'s mesh — what the analysis hands to
+    ``fn.lower`` so GSPMD resolves from declared intent."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if len(contract.args) != len(abstract_args):
+        raise ValueError(
+            f"contract declares {len(contract.args)} args but the entry "
+            f"point has {len(abstract_args)}")
+
+    def annotate(leaf, spec):
+        if leaf is None or not hasattr(leaf, "shape") or spec is None:
+            return leaf
+        return jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype,
+            sharding=NamedSharding(env.mesh, spec))
+
+    out = []
+    for role, arg in zip(contract.args, abstract_args):
+        if role == "state":
+            out.append(jax.tree_util.tree_map_with_path(
+                lambda p, l: annotate(
+                    l, contract.spec_for(state_leaf_role(p))), arg))
+        elif isinstance(arg, (int, float)) and not hasattr(arg, "shape"):
+            out.append(arg)
+        else:
+            spec = contract.spec_for(role)
+            out.append(jax.tree_util.tree_map(
+                lambda l: annotate(l, spec), arg))
+    return tuple(out)
+
+
+def simulated_mesh(n_devices: int, devices=None) -> MeshEnv:
+    """A mesh over the first ``n_devices`` local devices — the
+    fake-mesh machinery the audits compile against (tests/CLI force
+    CPU virtual devices).  The data×model factorization comes from
+    ``_MESH_SHAPES`` (n×1 for counts outside the matrix)."""
+    import jax
+
+    from gansformer_tpu.core.config import MeshConfig
+
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"simulated mesh needs {n_devices} devices, have "
+            f"{len(devices)} (run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n_devices})")
+    data, model = _MESH_SHAPES.get(n_devices, (n_devices, 1))
+    return make_mesh(MeshConfig(data=data, model=model),
+                     devices=devices[:n_devices])

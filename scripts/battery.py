@@ -19,9 +19,10 @@ weak #2).  This module makes the battery MULTI-WINDOW and RESUMABLE:
 
 Stage order is most-important-first (VERDICT r5 item 1): the four-phase +
 fused-cycle bench JSON (no sweep, 600 s inner budget) lands within the
-first ~10 minutes of the FIRST window; the attribution + lever A/B stages
-follow so one window converts into a measured decision table (PERF.md
-§1d); the sweep/pallas/train stages ride later windows if needed.
+first ~10 minutes of the FIRST window; the attribution + lever A/B +
+graftcomms stages follow so one window converts into a measured decision
+table (PERF.md §1d) plus a TPU-compiled comms table (ISSUE 6); the
+sweep/pallas/train stages ride later windows if needed.
 
   python scripts/battery.py run    [--out .probe]     # exit 0=complete, 3=partial
   python scripts/battery.py status [--out .probe]     # same exits, no side effects
@@ -76,14 +77,32 @@ def default_stages():
         stage("readiness_1024", 900, "readiness_1024_tpu.jsonl",
               [py, "scripts/readiness_ffhq1024.py",
                "--json-out", "{win}/readiness_1024_tpu.json"]),
-        # 5. Batch sweep (the optional throughput upside).
+        # 5. graftcomms (ISSUE 6): TPU-compiled collective inventory +
+        #    sharding-contract check over the full trace matrix.
+        #    --trace-native keeps the ambient TPU backend (mesh sizes
+        #    clamp to the window's chip count); the comms attribution
+        #    lands in the repo root so later bench stages/windows embed
+        #    expected_scaling, and is copied into the window ledger.
+        #    The stage's job is CAPTURE, not gating: lint exit 1 (new
+        #    findings — the discovery case) still counts as completed
+        #    as long as the artifact was written, otherwise a real
+        #    finding would re-burn 900 s in every window forever.
+        stage("graftcomms", 900, "graftcomms_tpu.json",
+              ["sh", "-c",
+               f"{py} -m gansformer_tpu.analysis.cli --trace"
+               f" --trace-native --trace-profile full --format json"
+               f" --json-out .comms_attribution.json; rc=$?;"
+               f" [ $rc -le 1 ] && [ -s .comms_attribution.json ]"],
+              copies=[(".comms_attribution.json",
+                       "comms_attribution.json")]),
+        # 6. Batch sweep (the optional throughput upside).
         stage("bench_sweep", 1800, "bench_sweep_tpu.json", [py, "bench.py"],
               env={"GRAFT_BENCH_TPU_TIMEOUT": "1500",
                    "GRAFT_BENCH_SWEEP": "16,32"}),
-        # 6. Native-kernel record (Mosaic compile + parity).
+        # 7. Native-kernel record (Mosaic compile + parity).
         stage("pallas", 600, "pallas_tpu.json",
               [py, "scripts/bench_pallas_attention.py"]),
-        # 7. Real loop on the chip; stats.jsonl carries timing/mfu.
+        # 8. Real loop on the chip; stats.jsonl carries timing/mfu.
         stage("train_ticks", 1200, None,
               [py, "-m", "gansformer_tpu.cli.train",
                "--preset", "ffhq256-duplex", "--data-source", "synthetic",

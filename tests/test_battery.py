@@ -60,7 +60,8 @@ def test_default_stage_order_and_headline_budget():
     assert first["env"]["GRAFT_BENCH_SWEEP"] == ""      # no sweep up front
     assert float(first["env"]["GRAFT_BENCH_TPU_TIMEOUT"]) <= 600
     assert first["budget_s"] <= 780
-    for required in ("components", "ab_levers", "readiness_1024"):
+    for required in ("components", "ab_levers", "readiness_1024",
+                     "graftcomms"):
         assert required in names
         assert names.index(required) < names.index("bench_sweep")
     # every {win} placeholder stays inside the window dir
@@ -68,6 +69,25 @@ def test_default_stage_order_and_headline_budget():
         for a in s["argv"]:
             if "{win}" in a:
                 assert a.startswith("{win}/"), a
+
+
+def test_graftcomms_stage_captures_tpu_comms_table():
+    """ISSUE 6 satellite: the battery records the comms attribution as
+    a stage artifact — native backend (TPU-compiled HLO), full trace
+    profile, repo-root artifact copied into the window ledger so
+    bench.py's expected_scaling finds it on later stages/windows."""
+    stages = {s["name"]: s for s in battery.default_stages()}
+    st = stages["graftcomms"]
+    argv = " ".join(st["argv"])
+    assert "gansformer_tpu.analysis.cli" in argv
+    assert "--trace-native" in argv and "--trace-profile full" in argv
+    assert "--json-out .comms_attribution.json" in argv
+    assert (".comms_attribution.json", "comms_attribution.json") \
+        in [tuple(c) for c in st["copies"]]
+    # capture beats verdict: lint exit 1 (new findings) still completes
+    # the stage when the artifact exists — else it re-fires forever
+    assert "[ $rc -le 1 ]" in argv
+    assert "[ -s .comms_attribution.json ]" in argv
 
 
 def test_default_probe_cmd_env_override(monkeypatch):

@@ -241,3 +241,63 @@ def test_bench_components_end_to_end_cpu(tmp_path):
     # cost model itself
     rank = {r["name"]: r["rank"] for r in rows}
     assert rank["pl_double_backward"] < rank["blur_up2_32"]
+
+
+# --- expected scaling (ISSUE 6: graftcomms → bench) -------------------------
+
+COMMS_PAYLOAD = {
+    "trace_profile": "full",
+    "mesh_sizes_compiled": [1, 2, 4],
+    "scaling_bytes_per_device": {
+        "steps.d_step[tiny-f32]": {"1": 0, "2": 120_000, "8": 210_000},
+        "steps.g_step[tiny-f32]": {"1": 0, "2": 0, "8": 0},
+        "steps.sample[tiny-f32]": {"1": 0, "2": 7_000, "8": 11_000},
+    },
+}
+
+
+def test_build_expected_scaling_per_phase_efficiency():
+    """graftcomms scaling bytes + measured phase ms → per-phase DP
+    efficiency: 1.0 at 1 chip, monotonically non-increasing with chip
+    count, and exactly 1.0 for a collective-free phase; non-phase
+    entries (sample) don't leak in."""
+    phase_ms = {"d": 30.0, "g": 28.0}
+    out = bench.build_expected_scaling(COMMS_PAYLOAD, phase_ms,
+                                       ici_bytes_per_s=1e9)
+    assert set(out["per_phase_efficiency"]) == {"d", "g"}
+    d = out["per_phase_efficiency"]["d"]
+    assert d["1"] == 1.0
+    assert d["1"] >= d["2"] >= d["8"]
+    # hand-check one point: 120 kB at 1 GB/s = 0.12 ms on a 30 ms step
+    assert d["2"] == pytest.approx(0.030 / (0.030 + 120_000 / 1e9),
+                                   abs=1e-4)
+    assert all(v == 1.0 for v in
+               out["per_phase_efficiency"]["g"].values())
+    assert out["assumed_ici_bytes_per_s"] == 1e9
+    assert out["comms_profile"] == "full"
+
+
+def test_build_expected_scaling_absent_when_nothing_matches():
+    assert bench.build_expected_scaling(COMMS_PAYLOAD, {"d_r1": 5.0}) \
+        is None
+    assert bench.build_expected_scaling({}, {"d": 30.0}) is None
+
+
+def test_build_expected_scaling_refuses_single_device_capture():
+    """A 1-chip tunnel window compiles no ≥2-device mesh and records
+    zero collectives — that must NOT surface as perfect scaling."""
+    starved = {**COMMS_PAYLOAD, "mesh_sizes_compiled": [1]}
+    assert bench.build_expected_scaling(starved, {"d": 30.0}) is None
+    absent = {k: v for k, v in COMMS_PAYLOAD.items()
+              if k != "mesh_sizes_compiled"}
+    assert bench.build_expected_scaling(absent, {"d": 30.0}) is None
+
+
+def test_load_comms_payload_tolerates_missing_and_torn(tmp_path):
+    assert bench._load_comms_payload(str(tmp_path / "nope.json")) is None
+    torn = tmp_path / "torn.json"
+    torn.write_text("{\"scaling")
+    assert bench._load_comms_payload(str(torn)) is None
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(COMMS_PAYLOAD))
+    assert bench._load_comms_payload(str(ok)) == COMMS_PAYLOAD

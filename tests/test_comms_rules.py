@@ -1,0 +1,381 @@
+"""Fixture tests for the graftcomms rules (ISSUE 6): partition-contract
+and collective-flow each get FIRES cases (seeded defects — the
+deliberately mis-specced donated leaf and the deliberate full-param
+all-gather from the acceptance criteria), QUIET cases, and
+suppression + baseline handling — mirroring tests/test_trace_rules.py
+for the ISSUE 4 rule families.  The pure helpers (HLO collective
+parsing, the ring wire-bytes model, the ranked table and the scaling
+prediction) are unit-tested on synthetic inputs.
+
+Fixture functions live in THIS file so findings anchor on real source
+lines here (inline ``# graftlint: disable=`` on the anchored line
+suppresses)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from gansformer_tpu.analysis.baseline import Baseline
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, def_site, line_text)
+from gansformer_tpu.analysis.trace.collective_flow import (
+    CollectiveFlowRule, comms_record, parse_collectives,
+    ranked_comms_table, scaling_report, scaling_efficiency, wire_bytes)
+from gansformer_tpu.analysis.trace.partition_contract import (
+    PartitionContractRule)
+from gansformer_tpu.parallel import contracts
+from gansformer_tpu.parallel.contracts import Contract
+
+MAT = jax.ShapeDtypeStruct((8, 64), np.float32)
+BIGP = jax.ShapeDtypeStruct((64, 4096), np.float32)      # 1 MiB params
+BIGX = jax.ShapeDtypeStruct((8, 512, 1024), np.float32)  # 16 MiB batch
+SMALLP = jax.ShapeDtypeStruct((64,), np.float32)
+GIANTO = jax.ShapeDtypeStruct((2048, 1024), np.float32)  # 8 MiB opt leaf
+
+STATE_CONTRACT = Contract(args=("state",), outs=("state",))
+FSDP_CONTRACT = Contract(args=("params", "batch"), outs=("stat",),
+                         role_specs={"params": P("data")})
+
+
+def ep_for(fn, *abstract_args, jit_kwargs=None, **fields):
+    jitted = jax.jit(fn, **(jit_kwargs or {}))
+    path, line = def_site(jitted)
+    return EntryPoint(name=f"fixture.{fn.__name__}", fn=jitted,
+                      abstract_args=abstract_args, path=path, line=line,
+                      **fields)
+
+
+def run_one(rule_cls, ep, mesh_sizes=(2,)):
+    ctx = TraceContext(mesh_sizes=mesh_sizes)
+    rule_cls().check(ep, ctx)
+    return ctx.findings, ctx
+
+
+def roundtrip_baseline(rule_cls, make_ep, tmp_path):
+    findings, _ = run_one(rule_cls, make_ep())
+    assert findings
+
+    def text_of(f):
+        return line_text(f.path, f.line)
+
+    bl = str(tmp_path / "baseline.json")
+    Baseline.write(bl, findings, text_of)
+    fresh, _ = run_one(rule_cls, make_ep())
+    Baseline.load(bl).apply(fresh, text_of)
+    assert all(f.baselined and not f.new for f in fresh)
+
+
+# --- partition-contract -----------------------------------------------------
+
+def _resharding_donor(s):
+    # the deliberately mis-specced donated leaf: contract says
+    # replicated, the program pins the donated output to the data axis
+    return jax.lax.with_sharding_constraint(s + 1.0, P("data"))
+
+
+def _resharding_donor_suppressed(s):  # graftlint: disable=partition-contract — fixture: suppression contract
+    return jax.lax.with_sharding_constraint(s + 1.0, P("data"))
+
+
+def _stable_donor(s):
+    return s + 1.0
+
+
+def _donor_ep(fn):
+    return ep_for(fn, MAT, jit_kwargs={"donate_argnums": (0,)},
+                  donate_argnums=(0,), contract=STATE_CONTRACT)
+
+
+def test_partition_contract_fires_on_misspecced_donated_leaf():
+    findings, ctx = run_one(PartitionContractRule,
+                            _donor_ep(_resharding_donor))
+    assert len(findings) == 1 and findings[0].new
+    assert "donated-leaf output" in findings[0].message
+    assert "contract says" in findings[0].message
+    assert not ctx.notes
+
+
+def test_partition_contract_quiet_on_conforming_program():
+    findings, ctx = run_one(PartitionContractRule,
+                            _donor_ep(_stable_donor), mesh_sizes=(2, 4))
+    assert findings == [] and not ctx.notes
+
+
+def test_partition_contract_flags_declared_input_conflict():
+    """Contract-sharded lowering pins the inputs, so an entry whose jit
+    DECLARES a conflicting in_sharding cannot silently win — the
+    conflict surfaces as a lowering-failed finding."""
+    from jax.sharding import NamedSharding
+
+    env = contracts.simulated_mesh(2)
+    jitted = jax.jit(lambda x: x * 2.0,
+                     in_shardings=NamedSharding(env.mesh, P()))
+    path, line = def_site(jitted)
+    ep = EntryPoint(name="fixture.repl_pinned", fn=jitted,
+                    abstract_args=(MAT,), path=path, line=line,
+                    contract=Contract(args=("batch",), outs=("batch",)))
+    findings, _ = run_one(PartitionContractRule, ep)
+    assert len(findings) == 1 and findings[0].new
+    assert "lowering failed" in findings[0].message
+
+
+def test_partition_contract_no_contract_is_a_note_not_a_pass():
+    ep = ep_for(_stable_donor, MAT)          # fixture name → no catalog hit
+    findings, ctx = run_one(PartitionContractRule, ep)
+    assert findings == []
+    assert any("no sharding contract" in n for n in ctx.notes)
+
+
+def test_partition_contract_needs_devices_note():
+    ep = _donor_ep(_stable_donor)
+    findings, ctx = run_one(PartitionContractRule, ep,
+                            mesh_sizes=(64,))
+    assert findings == []
+    assert any("64-device mesh" in n for n in ctx.notes)
+
+
+def test_partition_contract_suppressed():
+    findings, _ = run_one(PartitionContractRule,
+                          _donor_ep(_resharding_donor_suppressed))
+    assert len(findings) == 1
+    assert findings[0].suppressed and not findings[0].new
+
+
+def test_partition_contract_baselined(tmp_path):
+    roundtrip_baseline(PartitionContractRule,
+                       lambda: _donor_ep(_resharding_donor), tmp_path)
+
+
+# --- collective-flow --------------------------------------------------------
+
+def _full_gatherer(p, x):
+    # the deliberate full-param all-gather (missed-FSDP pattern):
+    # params sharded over data, compute consumes them FULL every call
+    full = jax.lax.with_sharding_constraint(p, P())
+    return (x @ full).sum()
+
+
+def _full_gatherer_suppressed(p, x):  # graftlint: disable=collective-flow — fixture: suppression contract
+    full = jax.lax.with_sharding_constraint(p, P())
+    return (x @ full).sum()
+
+
+def _sharded_consumer(p, x):
+    # consumes p SHARDED (elementwise + partial reduction): the FSDP
+    # layout pays a scalar all-reduce, never a gather
+    return (p * p).sum() + x.mean()
+
+
+def _activation_reducer(p, x):
+    # all-reduce of a 2 MiB activation (batch-mean over the sharded
+    # axis) against a 256 B param tree — bigger than any gradient
+    return x.mean(axis=0).sum() + p.sum()
+
+
+def _opt_reader(o, x):
+    return x.sum() + o.mean()
+
+
+def test_collective_flow_fires_on_full_param_all_gather():
+    ep = ep_for(_full_gatherer, BIGP, MAT, contract=FSDP_CONTRACT)
+    findings, ctx = run_one(CollectiveFlowRule, ep)
+    assert any("full-param all-gather" in f.message and f.new
+               for f in findings)
+    # and the comms table recorded the gather
+    assert ctx.comms[0]["collectives"]["all-gather"]["count"] >= 1
+
+
+def test_collective_flow_quiet_on_sharded_consumption():
+    ep = ep_for(_sharded_consumer, BIGP, MAT, contract=FSDP_CONTRACT)
+    findings, ctx = run_one(CollectiveFlowRule, ep)
+    assert findings == []
+    assert "all-gather" not in ctx.comms[0]["collectives"]
+
+
+def test_collective_flow_fires_on_oversized_all_reduce():
+    ep = ep_for(_activation_reducer, SMALLP, BIGX,
+                contract=Contract(args=("params", "batch"),
+                                  outs=("stat",)))
+    findings, _ = run_one(CollectiveFlowRule, ep)
+    assert any("exceeds the TOTAL params bytes" in f.message and f.new
+               for f in findings)
+
+
+def test_collective_flow_fires_on_replicated_opt_state():
+    ep = ep_for(_opt_reader, GIANTO, MAT,
+                contract=Contract(args=("opt_state", "batch"),
+                                  outs=("stat",)))
+    findings, _ = run_one(CollectiveFlowRule, ep)
+    assert any("opt-state leaf" in f.message and "fully replicated"
+               in f.message and f.new for f in findings)
+
+
+def test_collective_flow_single_device_records_but_never_flags():
+    ep = ep_for(_full_gatherer, BIGP, MAT, contract=FSDP_CONTRACT)
+    findings, ctx = run_one(CollectiveFlowRule, ep, mesh_sizes=(1,))
+    assert findings == []
+    assert len(ctx.comms) == 1 and ctx.comms[0]["devices"] == 1
+
+
+def test_collective_flow_suppressed():
+    ep = ep_for(_full_gatherer_suppressed, BIGP, MAT,
+                contract=FSDP_CONTRACT)
+    findings, _ = run_one(CollectiveFlowRule, ep)
+    assert findings and all(f.suppressed and not f.new for f in findings)
+
+
+def test_collective_flow_baselined(tmp_path):
+    roundtrip_baseline(
+        CollectiveFlowRule,
+        lambda: ep_for(_full_gatherer, BIGP, MAT, contract=FSDP_CONTRACT),
+        tmp_path)
+
+
+def test_rules_share_one_compile_per_entry_mesh():
+    """partition-contract and collective-flow compile the SAME
+    contract-sharded program — the shared ctx cache must make the
+    second rule free (one cache entry per entry×mesh)."""
+    ep = ep_for(_stable_donor, MAT, jit_kwargs={"donate_argnums": (0,)},
+                donate_argnums=(0,), contract=STATE_CONTRACT)
+    ctx = TraceContext(mesh_sizes=(2,))
+    PartitionContractRule().check(ep, ctx)
+    assert len(ctx._compiled) == 1
+    before = dict(ctx._compiled)
+    CollectiveFlowRule().check(ep, ctx)
+    assert len(ctx._compiled) == 1
+    assert ctx._compiled[(ep.name, 2)][0] is before[(ep.name, 2)][0]
+
+
+# --- pure helpers: HLO parsing, wire model, tables --------------------------
+
+HLO = """
+ENTRY %main {
+  %ag = f32[64,64]{1,0} all-gather(f32[32,64]{1,0} %p), channel_id=1, replica_groups=[1,2]<=[2], dimensions={0}
+  %ar = (f32[16]{0}, bf16[8]{0}) all-reduce(f32[16]{0} %a, bf16[8]{0} %b), replica_groups=[1,4]<=[4], to_apply=%sum
+  %rs = f32[8]{0} reduce-scatter(f32[16]{0} %c), replica_groups=[2,2]<=[4], dimensions={0}
+  %cp = f32[4]{0} collective-permute(f32[4]{0} %d), source_target_pairs={{0,1}}
+  %ars = f32[4]{0} all-reduce-start(f32[4]{0} %e), replica_groups={{0,1},{2,3}}
+  %ard = f32[4]{0} all-reduce-done(f32[4]{0} %ars)
+  %ags = (f32[32,64]{1,0}, f32[64,64]{1,0}) all-gather-start(f32[32,64]{1,0} %p), replica_groups=[1,2]<=[2], dimensions={0}
+  %agd = f32[64,64]{1,0} all-gather-done((f32[32,64]{1,0}, f32[64,64]{1,0}) %ags)
+  %user = f32[4]{0} add(f32[4]{0} %cp, f32[4]{0} %cp)
+}
+"""
+
+
+def test_parse_collectives_kinds_bytes_groups():
+    ops = parse_collectives(HLO, default_group=2)
+    kinds = [op["kind"] for op in ops]
+    # -done is NOT a second transfer; plain ops and -start both count
+    assert kinds == ["all-gather", "all-reduce", "reduce-scatter",
+                     "collective-permute", "all-reduce", "all-gather"]
+    ag, ar, rs, cp, ars, ags = ops
+    assert ag["payload_bytes"] == 64 * 64 * 4 and ag["group"] == 2
+    assert ar["payload_bytes"] == 16 * 4 + 8 * 2 and ar["group"] == 4
+    assert rs["payload_bytes"] == 8 * 4 * 2      # shard result × group
+    assert rs["group"] == 2
+    assert cp["payload_bytes"] == 16
+    assert ars["group"] == 2                     # {{0,1},{2,3}} groups of 2
+    # async all-gather-start: the (operand, result) bundle must not be
+    # summed — payload is the gathered FULL tensor only
+    assert ags["payload_bytes"] == 64 * 64 * 4
+
+
+def test_wire_bytes_ring_model():
+    assert wire_bytes("all-reduce", 1000, 2) == 1000       # 2N(g-1)/g
+    assert wire_bytes("all-reduce", 1000, 4) == 1500
+    assert wire_bytes("all-gather", 1000, 4) == 750        # N(g-1)/g
+    assert wire_bytes("reduce-scatter", 1000, 4) == 750
+    assert wire_bytes("collective-permute", 1000, 4) == 1000
+    assert wire_bytes("all-reduce", 1000, 1) == 0          # no peers
+
+
+def test_comms_record_and_ranked_table():
+    ops = parse_collectives(HLO, default_group=2)
+    rec2 = comms_record("e1", 2, ops, {"params": 7, "opt_state": 3})
+    rec4 = comms_record("e1", 4, ops, {"params": 7, "opt_state": 3})
+    quiet = comms_record("e0", 4, [], {})
+    assert rec2["param_bytes"] == 7 and rec2["opt_state_bytes"] == 3
+    assert rec2["collectives"]["all-reduce"]["count"] == 2
+    table = ranked_comms_table([rec2, quiet, rec4])
+    assert [r["entry"] for r in table] == ["e1", "e0"]   # ranked by wire
+    assert table[0]["devices"] == 4                      # largest mesh wins
+
+
+def test_scaling_report_ring_extrapolation():
+    ops = [{"kind": "all-reduce", "payload_bytes": 1000,
+            "wire_bytes_per_device": 1000, "group": 2}]
+    rec = comms_record("e", 2, ops, {})
+    rep = scaling_report([rec], chip_counts=(1, 2, 4, 64))
+    assert rep["e"]["1"] == 0
+    assert rep["e"]["2"] == 1000
+    assert rep["e"]["4"] == 1500
+    assert rep["e"]["64"] == int(2 * 1000 * 63 / 64)  # → 2N asymptote
+
+
+def test_scaling_efficiency_floor_model():
+    assert scaling_efficiency(0, 0.01, 1e9) == 1.0
+    eff = scaling_efficiency(10_000_000, 0.01, 1e9)   # 10ms comms, 10ms step
+    assert abs(eff - 0.5) < 1e-9
+    assert scaling_efficiency(1, 0.0, 1e9) == 0.0
+
+
+# --- contracts (parallel/contracts.py) --------------------------------------
+
+def test_state_leaf_roles_cover_train_state_fields():
+    import jax.tree_util as jtu
+
+    class K:         # stand-in for GetAttrKey
+        def __init__(self, name):
+            self.name = name
+
+    assert contracts.state_leaf_role((K("g_params"), K("w"))) == "params"
+    assert contracts.state_leaf_role((K("ema_params"),)) == "params"
+    assert contracts.state_leaf_role((K("d_opt"), K("mu"))) == "opt_state"
+    assert contracts.state_leaf_role((K("w_avg"),)) == "stat"
+    assert contracts.state_leaf_role(()) == "stat"
+
+
+def test_every_catalog_entry_has_a_contract():
+    """The loud-coverage satellite: every short name the entry-point
+    catalog registers resolves a contract (build_entry_points raises
+    otherwise — pinned by the structural gate in test_trace_clean)."""
+    for short in ("d_step", "d_step_r1", "g_step", "g_step_pl", "cycle",
+                  "sample", "ppl_pairs"):
+        assert contracts.contract_for(f"steps.{short}[tiny-f32]") \
+            is not None
+    assert contracts.contract_for("fixture.whatever") is None
+
+
+def test_contract_arity_mismatch_raises():
+    with pytest.raises(ValueError):
+        contracts.arg_leaf_contracts(STATE_CONTRACT, (MAT, MAT))
+    with pytest.raises(ValueError):
+        contracts.sharded_abstract_args(
+            STATE_CONTRACT, (MAT, MAT), contracts.simulated_mesh(2))
+
+
+def test_sharded_abstract_args_annotates_by_role():
+    env = contracts.simulated_mesh(2)
+    c = Contract(args=("params", "batch", "scalar"), outs=("batch",))
+    p, b, s = contracts.sharded_abstract_args(c, (SMALLP, MAT, 3), env)
+    assert p.sharding.spec == P()
+    assert b.sharding.spec == P("data")
+    assert s == 3                                     # scalars untouched
+
+
+def test_out_leaf_contracts_state_then_stat_tail():
+    state = {"g_params": {"w": MAT}, "w_avg": SMALLP}
+    c = Contract(args=("state",), outs=("state", "stat"))
+    out = contracts.out_leaf_contracts(c, (state,), 4)
+    roles = [r for _, r, _ in out]
+    assert roles == ["params", "stat", "stat", "stat"]
+    assert out[0][0].startswith("state:")
+    assert out[-1][0] == "out[3]"
+
+
+def test_unknown_role_raises():
+    with pytest.raises(KeyError):
+        Contract(args=("nonsense",), outs=("stat",)).spec_for("nonsense")

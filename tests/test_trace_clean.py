@@ -139,6 +139,41 @@ def test_sharding_audit_clean_on_real_train_step():
 
 
 @pytest.mark.slow
+def test_graftcomms_clean_on_real_entries_2_and_4_device_meshes():
+    """ISSUE 6 acceptance: partition-contract AND collective-flow are
+    clean (zero non-baselined findings, zero skip-notes) over EVERY
+    real entry point on the simulated 2- and 4-device meshes, and the
+    comms table covers every entry×mesh pair."""
+    from gansformer_tpu.analysis.trace.collective_flow import (
+        CollectiveFlowRule, ranked_comms_table, scaling_report)
+    from gansformer_tpu.analysis.trace.entry_points import (
+        build_entry_points)
+    from gansformer_tpu.analysis.trace.harness import run_trace
+    from gansformer_tpu.analysis.trace.partition_contract import (
+        PartitionContractRule)
+
+    eps = build_entry_points("tiny-f32")
+    findings, ctx = run_trace(
+        "fast", rules=[PartitionContractRule, CollectiveFlowRule],
+        entries=eps, mesh_sizes=(2, 4))
+    _assert_no_new(_apply_baseline(findings))
+    assert not ctx.notes, ctx.notes
+    assert {(r["entry"], r["devices"]) for r in ctx.comms} \
+        == {(ep.name, n) for ep in eps for n in (2, 4)}
+    # the train steps move real bytes; the ranked table reflects it
+    table = ranked_comms_table(ctx.comms)
+    by_entry = {r["entry"]: r for r in table}
+    assert by_entry["steps.d_step[tiny-f32]"][
+        "total_wire_bytes_per_device"] > 0
+    # the fused cycle tops the ranking (largest program, most traffic)
+    assert table[0]["entry"] == "steps.cycle[tiny-f32]"
+    # and the scaling prediction is monotone in chip count per entry
+    for entry, per_chip in scaling_report(ctx.comms).items():
+        seq = [per_chip[c] for c in sorted(per_chip, key=int)]
+        assert seq == sorted(seq), (entry, seq)
+
+
+@pytest.mark.slow
 def test_full_matrix_trace_clean():
     """Everything: all four rule families over every entry point of
     every matrix config — the exhaustive version of the gate."""
@@ -200,7 +235,109 @@ def test_cli_trace_flags_and_rule_selection(capsys):
     assert out == 0
     # --learning-trend requires --run-dir
     assert cli.main(["--learning-trend", "x.py"]) == 2
+    # the comms artifact / native backend only exist with --trace
+    assert cli.main(["--json-out", "x.json", "x.py"]) == 2
+    assert cli.main(["--trace-native", "x.py"]) == 2
     capsys.readouterr()
+
+
+def test_cli_trace_json_emits_comms_table(tmp_path, capsys):
+    """``gansformer-lint --trace --format json`` carries the graftcomms
+    sections, and ``--json-out`` writes the standalone attribution
+    artifact (structural profile: plumbing only — the slow gate covers
+    real content; the new rule ids are selectable and listed)."""
+    from gansformer_tpu.analysis import cli
+
+    art = tmp_path / "comms.json"
+    rc = cli.main(["--trace", "--trace-profile", "structural",
+                   "--select", "partition-contract,collective-flow",
+                   "--format", "json", "--json-out", str(art),
+                   os.path.join(ROOT, "gansformer_tpu", "analysis",
+                                "findings.py")])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["comms"] == []                 # structural: no compiles
+    assert payload["scaling_bytes_per_device"] == {}
+    assert payload["trace_profile"] == "structural"
+    saved = json.loads(art.read_text())
+    assert saved["version"] == 1 and saved["comms"] == []
+    # --list-rules names the graftcomms pair
+    assert cli.main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    assert "partition-contract" in listed and "collective-flow" in listed
+
+
+def test_harness_profiles_target_graftcomms_surface():
+    """Profile wiring: ``contracts`` runs ONLY partition-contract (on
+    the four train steps); ``fast`` gives the mesh rules all four train
+    steps (the _FAST_SHARDING satellite — no more d_step-only audits);
+    ``full`` uses the 1/2/4 mesh matrix, everything else the 2-device
+    mesh."""
+    from gansformer_tpu.analysis.trace import harness
+
+    class _EP:
+        def __init__(self, name, config_name="tiny-f32"):
+            self.name = name
+            self.arg_specs = ("state",)
+            self.config_name = config_name
+
+    eps = [_EP(f"steps.{s}[tiny-f32]") for s in
+           ("d_step", "d_step_r1", "g_step", "g_step_pl", "cycle",
+            "sample", "ppl_pairs")]
+    four = {f"steps.{s}[tiny-f32]" for s in
+            ("d_step", "d_step_r1", "g_step", "g_step_pl")}
+    for rule in ("sharding-audit", "partition-contract",
+                 "collective-flow"):
+        got = {e.name for e in
+               harness._dynamic_entries(rule, "fast", eps)}
+        assert got == four, rule
+    assert {e.name for e in harness._dynamic_entries(
+        "partition-contract", "contracts", eps)} == four
+    assert harness._dynamic_entries("collective-flow", "contracts",
+                                    eps) == []
+    assert harness._dynamic_entries("retrace-hazard", "contracts",
+                                    eps) == []
+    assert len(harness._dynamic_entries("collective-flow", "full",
+                                        eps)) == len(eps)
+    # the bf16 matrix member is a dtype-flow fixture, not a layout one:
+    # the mesh-compiling rules skip it even under full
+    mixed = eps + [_EP("steps.d_step[tiny-bf16]",
+                       config_name="tiny-bf16")]
+    assert {e.name for e in harness._dynamic_entries(
+        "partition-contract", "full", mixed)} == {e.name for e in eps}
+    assert len(harness._dynamic_entries("retrace-hazard", "full",
+                                        mixed)) == len(mixed)
+    assert harness.mesh_sizes_for("full") == (1, 2, 4)
+    assert harness.mesh_sizes_for("fast") == (2,)
+    assert harness.mesh_sizes_for("contracts") == (2,)
+
+
+def test_entry_points_reject_incomplete_coverage(monkeypatch):
+    """The loud-coverage guard (ISSUE 6 satellite): every real entry
+    carries complete per-arg placement tags AND a declared contract —
+    and removing a contract makes the build RAISE instead of riding
+    the audits' silent skip-note path (which once exempted the
+    inference programs the serving path will reuse)."""
+    import pytest as _pytest
+
+    from gansformer_tpu.analysis.trace import entry_points
+    from gansformer_tpu.parallel import contracts
+
+    # one build covers both halves (the inference programs prove the
+    # old exemption path is closed; full-catalog spec/contract
+    # completeness is pinned by test_comms_rules + the structural gate)
+    eps = entry_points.build_entry_points(
+        "tiny-f32", include=["sample", "ppl_pairs"])
+    assert {ep.name.split(".")[1].split("[")[0] for ep in eps} \
+        == {"sample", "ppl_pairs"}
+    for ep in eps:
+        assert len(ep.arg_specs) == len(ep.abstract_args), ep.name
+        assert contracts.contract_for(ep.name) is not None, ep.name
+
+    monkeypatch.delitem(contracts.ENTRY_CONTRACTS, "ppl_pairs")
+    with _pytest.raises(ValueError, match="no sharding contract"):
+        entry_points.build_entry_points("tiny-f32",
+                                        include=["ppl_pairs"])
 
 
 def test_cli_run_dir_learning_trend(tmp_path, capsys):
@@ -224,14 +361,26 @@ def test_selfcheck_writes_artifact(tmp_path, monkeypatch):
     need to re-trace the matrix inside a unit test)."""
     from gansformer_tpu.analysis import cli
 
-    monkeypatch.setattr(cli, "run_trace_findings",
-                        lambda profile, rules: [])
+    seen = {}
+
+    def fake_trace(profile, rules, native=False):
+        seen["profile"], seen["native"] = profile, native
+        return [], {"comms": [], "scaling_bytes_per_device": {},
+                    "trace_profile": profile,
+                    "mesh_sizes_requested": [2],
+                    "mesh_sizes_compiled": [2], "notes": []}
+
+    monkeypatch.setattr(cli, "run_trace_findings", fake_trace)
     n_new = cli.run_selfcheck(str(tmp_path))
     assert n_new == 0
+    # ISSUE 6 satellite: selfcheck runs structural + the contract check
+    # (the "contracts" profile) on the ambient backend
+    assert seen == {"profile": "contracts", "native": True}
     artifact = tmp_path / "graftlint.json"
     assert artifact.exists()
     payload = json.loads(artifact.read_text())
     assert payload["ok"] and payload["files_checked"] > 0
+    assert payload["trace_profile"] == "contracts"   # comms extra rides
 
 
 def test_train_cli_exposes_selfcheck():
@@ -242,9 +391,13 @@ def test_train_cli_exposes_selfcheck():
     assert build_parser().parse_args([]).selfcheck is False
 
 
-def test_precommit_config_invokes_ast_half():
+def test_precommit_config_invokes_ast_plus_contracts():
+    """The hook runs the AST rules plus the cheap trace end: structural
+    tracing + the PartitionSpec-contract check (``--trace-profile
+    contracts``) — never the expensive retrace/full-matrix profiles."""
     with open(os.path.join(ROOT, ".pre-commit-config.yaml")) as f:
         content = f.read()
     entry = [ln for ln in content.splitlines() if "entry:" in ln]
     assert entry and "gansformer_tpu.analysis.cli" in entry[0]
-    assert "--trace" not in entry[0]    # trace rules stay out of hooks
+    assert "--trace-profile contracts" in entry[0]
+    assert "full" not in entry[0] and "fast" not in entry[0]
