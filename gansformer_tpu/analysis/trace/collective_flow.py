@@ -9,7 +9,7 @@ comms-cost table in ``ctx.comms`` — the comms twin of
 ``bench_components.py``'s per-op FLOP attribution (exported via
 ``gansformer-lint --json-out``).
 
-Three anti-patterns become findings:
+Four anti-patterns become findings:
 
 * **full-param all-gather** — a single all-gather whose payload covers
   most of the params-role input bytes: the program re-materializes the
@@ -23,6 +23,12 @@ Three anti-patterns become findings:
 * **replicated opt-state** — an opt-state-role input leaf above a size
   threshold resolving fully replicated: every chip holds a full copy
   of Adam moments that FSDP would shard for free.
+* **replicated compute** (ISSUE 7) — a TRAIN-STEP program compiled on
+  a multi-device data mesh with ZERO all-reduces: gradient-descent
+  over a sharded batch must reduce gradients across the data axis, so
+  no all-reduce means the batch never sharded and N chips each run
+  the full batch (the ``g_step`` defect this repo shipped for six
+  PRs — a flat scaling row that was replicated work, not scaling).
 
 Byte accounting: ``payload`` is the logical tensor moved (the HLO
 result shape; for reduce-scatter, result × group).  ``wire`` is the
@@ -226,11 +232,14 @@ class CollectiveFlowRule(TraceRule):
     id = "collective-flow"
     description = ("collective anti-pattern in the compiled SPMD "
                    "program: full-param all-gather (missed FSDP), "
-                   "all-reduce larger than the gradient tree, or "
-                   "oversize fully-replicated opt-state")
+                   "all-reduce larger than the gradient tree, "
+                   "oversize fully-replicated opt-state, or a train "
+                   "step with ZERO all-reduces on a multi-device data "
+                   "mesh (replicated compute)")
     hint = ("consume params sharded (or revert the sharding), keep "
-            "reductions device-local until the gradient psum, and "
-            "shard optimizer moments alongside their params")
+            "reductions device-local until the gradient psum, shard "
+            "optimizer moments alongside their params, and constrain "
+            "in-step batch draws onto the data axis")
     dynamic = True
 
     full_gather_min = FULL_GATHER_MIN_BYTES
@@ -269,6 +278,7 @@ class CollectiveFlowRule(TraceRule):
             if n > 1:        # a 1-device program has no collectives
                 self._flag_anti_patterns(ep, ctx, ops, role_bytes,
                                          compiled, contract)
+                self._flag_replicated_compute(ep, ctx, ops, n)
 
     # -- anti-patterns -------------------------------------------------------
 
@@ -305,6 +315,31 @@ class CollectiveFlowRule(TraceRule):
                            f"activation-sized reduction that should "
                            f"stay device-local")
         self._flag_replicated_opt_state(ep, ctx, compiled, contract)
+
+    def _flag_replicated_compute(self, ep, ctx, ops, n_devices) -> None:
+        """Train-step × multi-device data mesh × zero all-reduces =
+        replicated compute.  A gradient step over a data-sharded batch
+        MUST all-reduce gradients; its absence means the in-step
+        latent/batch path never sharded, so the mesh buys replicated
+        work (the exact defect ISSUE 7 fixed — this check keeps it
+        fixed).  Gated on ``ep.train_step``: inference programs
+        (sample/ppl_pairs) legitimately compile collective-free."""
+        from gansformer_tpu.parallel.contracts import simulated_mesh
+
+        if not ep.train_step:
+            return
+        if simulated_mesh(n_devices).data_size <= 1:
+            return           # model-only mesh: no data axis to reduce over
+        if any(op["kind"] == "all-reduce" for op in ops):
+            return
+        ctx.report(self, ep.anchor,
+                   f"{ep.name}: compiled to ZERO all-reduces on the "
+                   f"{n_devices}-device data mesh — a train step over a "
+                   f"sharded batch must all-reduce gradients, so this "
+                   f"program's compute is replicated (N chips, N copies "
+                   f"of the same work); shard the in-step latent/batch "
+                   f"draws onto the data axis "
+                   f"(parallel/mesh.constrain_data_axis)")
 
     def _flag_replicated_opt_state(self, ep, ctx, compiled,
                                    contract) -> None:

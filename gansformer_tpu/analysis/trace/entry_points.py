@@ -93,16 +93,29 @@ class _StateFactory:
 
 def build_entry_points(config_name: str,
                        cfg: Optional[ExperimentConfig] = None,
-                       include: Optional[List[str]] = None
+                       include: Optional[List[str]] = None,
+                       fsdp: bool = False
                        ) -> List[EntryPoint]:
     """EntryPoints for one config.  ``include`` filters by short name
-    (``d_step``, ``g_step``, …); None = all for that config."""
+    (``d_step``, ``g_step``, …); None = all for that config.
+    ``fsdp=True`` attaches the FSDP contract overlay
+    (``parallel/contracts.entry_contracts(fsdp=True)``) so the mesh
+    rules assert the sharded-opt-state intent — the step functions
+    themselves are identical (the layout is input-sharding-driven)."""
+    import dataclasses
+
     import jax
     import numpy as np
 
     from gansformer_tpu.train.steps import make_train_steps
 
     cfg = cfg or trace_configs()[config_name]
+    if fsdp and not cfg.mesh.fsdp:
+        # the in-step layout pin (pin_state_layout, a closure inside
+        # steps.make_train_steps) is driven by the config — the FSDP
+        # entries must trace the fsdp program
+        cfg = dataclasses.replace(
+            cfg, mesh=dataclasses.replace(cfg.mesh, fsdp=True))
     m, t = cfg.model, cfg.train
     fns = make_train_steps(cfg, None, batch_size=t.batch_size)
     state_abs = _abstract_state(cfg)
@@ -156,7 +169,9 @@ def build_entry_points(config_name: str,
             abstract_args=abstract_args, make_args=make_args,
             static_kwargs=static_kwargs or {}, path=path, line=line,
             donate_argnums=donate, train_step=train_step,
-            arg_specs=arg_specs, **common))
+            arg_specs=arg_specs,
+            contract=contract_for(short, fsdp=True) if fsdp else None,
+            **common))
 
     add("d_step", fns.d_step, (state_abs, imgs_abs, key_abs),
         lambda: (states.fresh(), imgs(), key(1)),

@@ -84,7 +84,9 @@ class PartitionContractRule(TraceRule):
             arg_leaf_contracts, out_leaf_contracts)
 
         # -- inputs ----------------------------------------------------------
-        leaf_info = arg_leaf_contracts(contract, ep.abstract_args)
+        # data_size lets FSDP-sentinel roles resolve per-leaf specs
+        leaf_info = arg_leaf_contracts(contract, ep.abstract_args,
+                                       data_size=env.data_size)
         flat_in, _ = jax.tree_util.tree_flatten(compiled.input_shardings[0])
         in_leaves = [l for _, l in
                      jax.tree_util.tree_flatten_with_path(
@@ -112,7 +114,8 @@ class PartitionContractRule(TraceRule):
         # -- outputs (incl. the donated state's returned leaves) -------------
         flat_out, _ = jax.tree_util.tree_flatten(compiled.output_shardings)
         out_info = out_leaf_contracts(contract, ep.abstract_args,
-                                      len(flat_out))
+                                      len(flat_out),
+                                      data_size=env.data_size)
         if len(out_avals) != len(flat_out):
             ctx.notes.append(f"{ep.name}: output arity mismatch "
                              f"({len(flat_out)} shardings, "

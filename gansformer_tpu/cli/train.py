@@ -132,6 +132,18 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="sequence_parallel",
                    help="disable sequence parallelism (overrides a loaded "
                         "config that enabled it)")
+    # Tri-state like the other layout flags: None inherits the loaded
+    # config (a resumed --fsdp run keeps its layout without re-passing).
+    p.add_argument("--fsdp", action="store_const", const=True,
+                   dest="fsdp", default=None,
+                   help="shard optimizer-state leaves over the data mesh "
+                        "axis (ZeRO-1; params/EMA stay replicated — no "
+                        "parameter gather in compute).  Needs a data "
+                        "axis > 1; validation explains misuse in words")
+    p.add_argument("--no-fsdp", action="store_const", const=False,
+                   dest="fsdp",
+                   help="replicate optimizer state (overrides a loaded "
+                        "config that enabled fsdp)")
     p.add_argument("--coordinator", default=None,
                    help="host:port for jax.distributed.initialize")
     p.add_argument("--num-processes", type=int, default=None)
@@ -189,6 +201,9 @@ def config_from_args(args) -> ExperimentConfig:
         model=(getattr(args, "mesh_model", None)
                if getattr(args, "mesh_model", None) is not None
                else cfg.mesh.model),
+        fsdp=(getattr(args, "fsdp", None)
+              if getattr(args, "fsdp", None) is not None
+              else cfg.mesh.fsdp),
         coordinator_address=args.coordinator or cfg.mesh.coordinator_address,
         num_processes=(args.num_processes if args.num_processes is not None
                        else cfg.mesh.num_processes),

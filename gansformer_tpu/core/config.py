@@ -256,6 +256,15 @@ class MeshConfig:
 
     data: int = -1   # -1: use all visible devices
     model: int = 1
+    # FSDP mode (ISSUE 7): shard optimizer-state leaves per-leaf over
+    # the data axis (parallel/contracts.fsdp_spec — ZeRO-1).  Params,
+    # EMA, and stats stay replicated, so forward/backward never pays a
+    # parameter gather; the step pays per-leaf all-gathers of the
+    # Adam UPDATES instead (priced in the collective-flow table).
+    # Cuts the per-chip replicated opt-state footprint (~2x params per
+    # optimizer) by the data-axis factor.  Default off — a data=1 mesh
+    # makes it a no-op and the replicated layout stays bit-identical.
+    fsdp: bool = False
     # multi-host process group (jax.distributed.initialize) parameters
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -346,6 +355,16 @@ class ExperimentConfig:
                 f"train.batch_size ({t.batch_size}) must be divisible by "
                 f"model.mbstd_group_size ({m.mbstd_group_size}) — the "
                 f"stddev layer would silently use a smaller group")
+        if self.mesh.fsdp and self.mesh.data == 1:
+            errs.append("mesh.fsdp with mesh.data=1 — there is no data "
+                        "axis to shard optimizer state over; drop --fsdp "
+                        "or grow the data axis")
+        if self.mesh.fsdp and (self.mesh.coordinator_address is not None
+                               or (self.mesh.num_processes or 1) > 1):
+            errs.append("mesh.fsdp is single-host for now: the npz "
+                        "checkpoint path gathers state to one process "
+                        "(multi-host sharded checkpointing is ROADMAP "
+                        "item 5); drop --fsdp or the multi-host flags")
         if self.mesh.model > 1 and not m.sequence_parallel:
             errs.append("mesh.model > 1 without model.sequence_parallel — "
                         "the model axis would idle; set sequence_parallel "

@@ -62,6 +62,32 @@ ROLE_SPECS: Dict[str, Optional[P]] = {
     "scalar": None,
 }
 
+# Sentinel role-spec value: "shard this role's leaves per-leaf with
+# ``fsdp_spec``" — a single PartitionSpec cannot express FSDP because
+# the shardable axis depends on each leaf's shape.  Used as a
+# ``role_specs`` override value (see ``entry_contracts(fsdp=True)``).
+FSDP = "fsdp"
+
+
+def fsdp_spec(shape: Tuple[int, ...], data_size: int) -> P:
+    """THE per-leaf FSDP placement rule: shard the largest axis the
+    ``data`` mesh axis divides evenly; replicate leaves with no such
+    axis (scalars, odd-length vectors, Adam ``count``).  Ties pick the
+    LAST such axis (output channels — the contiguous-major dim on
+    typical conv/dense kernels).  Deterministic and shape-only, so the
+    runtime placement (``state_shardings``), the analysis contracts,
+    and the checked expectations all derive the same spec."""
+    if data_size <= 1:
+        return P()
+    best = None
+    for i, d in enumerate(shape):
+        if d > 0 and d % data_size == 0:
+            if best is None or d >= shape[best]:
+                best = i
+    if best is None:
+        return P()
+    return P(*([None] * best), DATA_AXIS)
+
 
 @dataclasses.dataclass(frozen=True)
 class Contract:
@@ -78,15 +104,27 @@ class Contract:
 
     args: Tuple[str, ...]
     outs: Tuple[str, ...]
-    role_specs: Optional[Mapping[str, Optional[P]]] = None
+    role_specs: Optional[Mapping[str, Any]] = None
 
-    def spec_for(self, role: str) -> Optional[P]:
+    def spec_for(self, role: str, shape: Optional[Tuple[int, ...]] = None,
+                 data_size: Optional[int] = None) -> Optional[P]:
+        """Intended spec for one leaf.  The ``FSDP`` sentinel resolves
+        per-leaf via ``fsdp_spec`` — callers that know the leaf pass
+        ``shape``/``data_size``; without them the sentinel resolves to
+        None (no expectation), so shape-blind consumers (role-byte
+        accounting) stay correct."""
         if self.role_specs is not None and role in self.role_specs:
-            return self.role_specs[role]
-        if role not in ROLE_SPECS:
-            raise KeyError(f"unknown contract role {role!r}; "
-                           f"have {sorted(ROLE_SPECS)}")
-        return ROLE_SPECS[role]
+            val = self.role_specs[role]
+        else:
+            if role not in ROLE_SPECS:
+                raise KeyError(f"unknown contract role {role!r}; "
+                               f"have {sorted(ROLE_SPECS)}")
+            val = ROLE_SPECS[role]
+        if isinstance(val, str) and val == FSDP:
+            if shape is None or data_size is None:
+                return None
+            return fsdp_spec(tuple(shape), data_size)
+        return val
 
 
 _TRAIN_STEP = Contract(args=("state", "batch", "rng"),
@@ -115,6 +153,30 @@ ENTRY_CONTRACTS: Dict[str, Contract] = {
 }
 
 
+# The FSDP role override (MeshConfig.fsdp / cli --fsdp): optimizer
+# moments shard per-leaf over ``data`` (ZeRO-1 — the biggest replicated
+# deadweight, ~2× params per optimizer); compute params and the EMA
+# tree stay replicated, so forward/backward never pays a param gather
+# and eval samples from a whole tree.  The step programs then pay
+# per-leaf all-gathers of the UPDATES at apply time — each far below
+# the full-param-gather threshold — which is the declared ZeRO-1 cost
+# the collective-flow table prices.
+FSDP_ROLE_SPECS: Dict[str, Any] = {"opt_state": FSDP}
+
+
+def entry_contracts(fsdp: bool = False) -> Dict[str, Contract]:
+    """The contract table for one layout mode.  ``fsdp=False`` is THE
+    base table (same dict object — tests monkeypatch it); ``fsdp=True``
+    overlays ``FSDP_ROLE_SPECS`` on every entry so the partition-
+    contract / collective-flow rules gate the sharded-opt-state intent
+    instead of the replicated one."""
+    if not fsdp:
+        return ENTRY_CONTRACTS
+    return {name: dataclasses.replace(
+                c, role_specs={**(c.role_specs or {}), **FSDP_ROLE_SPECS})
+            for name, c in ENTRY_CONTRACTS.items()}
+
+
 def short_entry_name(name: str) -> str:
     """"steps.d_step[tiny-f32]" → "d_step" (fixture names pass through
     unchanged when they don't follow the catalog convention)."""
@@ -122,8 +184,8 @@ def short_entry_name(name: str) -> str:
     return tail.split("[", 1)[0]
 
 
-def contract_for(name: str) -> Optional[Contract]:
-    return ENTRY_CONTRACTS.get(short_entry_name(name))
+def contract_for(name: str, fsdp: bool = False) -> Optional[Contract]:
+    return entry_contracts(fsdp).get(short_entry_name(name))
 
 
 def key_str(entry: Any) -> str:
@@ -153,11 +215,14 @@ def _flatten_with_paths(tree):
     return jax.tree_util.tree_flatten_with_path(tree)[0]
 
 
-def arg_leaf_contracts(contract: Contract, abstract_args: Tuple[Any, ...]
+def arg_leaf_contracts(contract: Contract, abstract_args: Tuple[Any, ...],
+                       data_size: Optional[int] = None
                        ) -> List[Tuple[int, Tuple, str, Optional[P]]]:
     """Flattened input-leaf view of the contract, aligned with
     ``jax.tree_util.tree_flatten(abstract_args)`` order: one
-    ``(arg_index, path, role, intended_spec)`` per leaf."""
+    ``(arg_index, path, role, intended_spec)`` per leaf.  ``data_size``
+    lets FSDP-sentinel roles resolve their per-leaf spec; without it
+    those specs are None (no expectation)."""
     if len(contract.args) != len(abstract_args):
         raise ValueError(
             f"contract declares {len(contract.args)} args but the entry "
@@ -167,13 +232,15 @@ def arg_leaf_contracts(contract: Contract, abstract_args: Tuple[Any, ...]
         for path, leaf in _flatten_with_paths(arg):
             leaf_role = state_leaf_role(path) if role == "state" else role
             spec = (None if not hasattr(leaf, "shape")
-                    else contract.spec_for(leaf_role))
+                    else contract.spec_for(leaf_role, leaf.shape,
+                                           data_size))
             out.append((i, tuple(path), leaf_role, spec))
     return out
 
 
 def out_leaf_contracts(contract: Contract, abstract_args: Tuple[Any, ...],
-                       n_out_leaves: int
+                       n_out_leaves: int,
+                       data_size: Optional[int] = None
                        ) -> List[Tuple[str, str, Optional[P]]]:
     """Role + intended spec per flattened OUTPUT leaf: ``"state"`` in
     ``outs`` consumes the arg-0 state's leaves (donated; same treedef —
@@ -185,7 +252,9 @@ def out_leaf_contracts(contract: Contract, abstract_args: Tuple[Any, ...],
             leaf_role = state_leaf_role(path)
             label = "/".join(key_str(p) for p in path)
             out.append((f"state:{label}", leaf_role,
-                        contract.spec_for(leaf_role)))
+                        contract.spec_for(leaf_role,
+                                          getattr(leaf, "shape", None),
+                                          data_size)))
     tail_role = contract.outs[-1]
     if tail_role == "state":        # outs == ("state",): no aux tail
         tail_role = "stat"
@@ -221,14 +290,40 @@ def sharded_abstract_args(contract: Contract,
         if role == "state":
             out.append(jax.tree_util.tree_map_with_path(
                 lambda p, l: annotate(
-                    l, contract.spec_for(state_leaf_role(p))), arg))
+                    l, contract.spec_for(state_leaf_role(p),
+                                         getattr(l, "shape", None),
+                                         env.data_size)), arg))
         elif isinstance(arg, (int, float)) and not hasattr(arg, "shape"):
             out.append(arg)
         else:
-            spec = contract.spec_for(role)
             out.append(jax.tree_util.tree_map(
-                lambda l: annotate(l, spec), arg))
+                lambda l: annotate(
+                    l, contract.spec_for(role, getattr(l, "shape", None),
+                                         env.data_size)), arg))
     return tuple(out)
+
+
+def state_shardings(state: Any, env: MeshEnv, fsdp: bool = False):
+    """Per-leaf ``NamedSharding`` tree for a TrainState — THE runtime
+    placement (train/loop.py ``device_put``s onto it) derived from the
+    same role/spec logic the analysis contracts assert, so the loop and
+    the partition-contract rule can never drift apart.  ``fsdp=False``
+    is everything-replicated (the historical layout); ``fsdp=True``
+    shards optimizer moments per-leaf (``fsdp_spec``), params/EMA/stats
+    replicated."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def sharding_of(path, leaf):
+        role = state_leaf_role(path)
+        if fsdp and role == "opt_state":
+            spec = fsdp_spec(tuple(getattr(leaf, "shape", ())),
+                             env.data_size)
+        else:
+            spec = P()
+        return NamedSharding(env.mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(sharding_of, state)
 
 
 def simulated_mesh(n_devices: int, devices=None) -> MeshEnv:

@@ -104,6 +104,62 @@ class MeshEnv:
         return set_mesh(self.mesh)
 
 
+def ambient_mesh():
+    """The ambient mesh (``set_mesh`` on jax ≥ 0.6, ``with Mesh:`` on
+    0.4/0.5 — the two forms ``MeshEnv.activate`` installs), or None.
+
+    Same resolution order as the sequence-parallel constraint in
+    ``models/attention.py``: prefer the abstract mesh, but an empty one
+    must fall through to the thread-resources physical mesh — on the
+    jax-0.5.x window ``with Mesh:`` populates only the latter."""
+    mesh = None
+    try:
+        from jax.sharding import get_abstract_mesh
+
+        mesh = get_abstract_mesh()
+    except ImportError:
+        pass
+    if mesh is None or mesh.empty:
+        try:
+            from jax._src.mesh import thread_resources
+        except ImportError:     # private symbol gone: treat as no mesh
+            return None
+        mesh = thread_resources.env.physical_mesh
+    return None if mesh.empty else mesh
+
+
+def ambient_data_size() -> int:
+    """Size of the ambient mesh's ``data`` axis (1 when no ambient mesh
+    or no data axis) — the trace-time question the in-step batch
+    constraints ask before pinning a spec."""
+    mesh = ambient_mesh()
+    if mesh is None or DATA_AXIS not in mesh.axis_names:
+        return 1
+    return mesh.shape[DATA_AXIS]
+
+
+def constrain_data_axis(x, axis: int = 0):
+    """Pin a per-example array's batch axis onto the ``data`` mesh axis.
+
+    THE batch-parallelism hook for arrays *created inside* a jitted
+    step (latent/noise draws): without it a replicated RNG key makes
+    the whole downstream compute replicated — N chips each doing the
+    full batch — and the compiled program shows zero collectives (the
+    graftcomms finding that motivated ISSUE 7).  With it, GSPMD shards
+    synthesis over ``data`` and inserts the gradient all-reduce.
+
+    No-op when no ambient mesh (or no data axis, or a batch the axis
+    doesn't divide — e.g. the path-length probe at batch//pl_shrink):
+    the value is IDENTICAL either way (a sharding constraint is a
+    layout annotation, not math), so mesh data=1 runs are bit-identical
+    to the unconstrained program."""
+    size = ambient_data_size()
+    if size <= 1 or x.shape[axis] % size != 0:
+        return x
+    spec = P(*([None] * axis), DATA_AXIS)
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
 def init_distributed(cfg: MeshConfig) -> None:
     """Form the multi-host process group (no-op for single-process runs).
 

@@ -211,8 +211,22 @@ def _train(cfg: ExperimentConfig, run_dir: str,
             state = ckpt.restore(ckpt_dir, state)
             log.write(f"resumed from step {last} ({last / 1000:.1f} kimg)")
 
-    # replicate state across the mesh; batches arrive sharded on 'data'
-    state = jax.device_put(state, env.replicated())
+    # State placement: params/EMA/stats replicated across the mesh;
+    # under --fsdp the optimizer moments shard per-leaf over the data
+    # axis (parallel/contracts.state_shardings — the SAME derivation
+    # the partition-contract rule asserts).  Batches arrive sharded on
+    # 'data' either way.
+    if cfg.mesh.fsdp:
+        from gansformer_tpu.parallel.contracts import state_shardings
+
+        placements = state_shardings(state, env, fsdp=True)
+        state = jax.device_put(state, placements)
+        n_shard = sum(1 for s in jax.tree_util.tree_leaves(placements)
+                      if not s.is_fully_replicated)
+        log.write(f"fsdp: optimizer state sharded over data={env.data_size} "
+                  f"({n_shard} sharded leaves; params/EMA replicated)")
+    else:
+        state = jax.device_put(state, env.replicated())
     fns = make_train_steps(cfg, env, batch_size=t.batch_size)
     if t.async_checkpoint and t.snapshot_ticks:
         # Compile the async-save staging program NOW (setup, outside any

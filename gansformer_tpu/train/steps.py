@@ -9,9 +9,13 @@ TPU-native design:
 * ONE function per phase combination — ``(d, d+r1, g, g+pl)`` — each a
   separate jit specialization selected in Python by ``step % interval``
   (static dispatch; no recompile churn — SURVEY.md §7.3 item 2).
-* Data parallelism is invisible: the batch arrives sharded over the ``data``
-  mesh axis, params replicated; XLA turns the loss mean into a ``psum`` over
-  ICI.  No gradient-all-reduce code exists anywhere.
+* Data parallelism is two annotations, not a subsystem: input batches
+  arrive sharded over the ``data`` mesh axis, and the IN-STEP latent
+  draws are constrained onto it too (``_sample_z`` — a replicated key
+  alone would replicate all G compute; ISSUE 7); params replicated
+  (opt-state optionally FSDP-sharded — ``pin_state_layout``); XLA turns
+  the loss mean into a ``psum`` over ICI.  No gradient-all-reduce code
+  exists anywhere.
 * State is donated: params/opt-state buffers are updated in place in HBM.
 * Style mixing (reference ``style_mixing_prob``) swaps a random suffix of
   latent components to a second mapping pass — implemented with a
@@ -39,7 +43,8 @@ from gansformer_tpu.losses.gan import (
 )
 from gansformer_tpu.models.discriminator import Discriminator
 from gansformer_tpu.models.generator import Generator
-from gansformer_tpu.parallel.mesh import MeshEnv
+from gansformer_tpu.parallel.mesh import (
+    MeshEnv, ambient_data_size, constrain_data_axis)
 from gansformer_tpu.train.state import TrainState, make_optimizers
 
 Metrics = Dict[str, jax.Array]
@@ -103,8 +108,18 @@ def _wrap_cycle(cycle_jit, wrapped):
 
 
 def _sample_z(cfg, rng, batch):
+    """In-step latent draw, SHARDED onto the data mesh axis.
+
+    The key is replicated (every device folds the same stream — the
+    fused/unfused parity contract), so without the constraint the whole
+    G compute downstream is replicated: N chips synthesize the same
+    full batch and the compiled step has zero collectives (the ISSUE 7
+    graftcomms finding).  The constraint makes GSPMD shard synthesis
+    over ``data`` and turn the gradient mean into an all-reduce; values
+    are unchanged, so mesh data=1 training is bit-identical."""
     m = cfg.model
-    return jax.random.normal(rng, (batch, m.num_ws, m.latent_dim), jnp.float32)
+    z = jax.random.normal(rng, (batch, m.num_ws, m.latent_dim), jnp.float32)
+    return constrain_data_axis(z)
 
 
 def apply_truncation(ws: jax.Array, w_avg: jax.Array,
@@ -126,6 +141,43 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
     batch = batch_size if batch_size is not None else t.batch_size
     w_avg_beta = 0.995
 
+    def pin_state_layout(st: TrainState) -> TrainState:
+        """Pin the UPDATED state to the declared layout
+        (parallel/contracts): params/EMA/stats replicated; opt moments
+        replicated, or per-leaf on ``data`` under ``mesh.fsdp``.
+
+        Two failure modes without the pin, both observed on a 2-device
+        mesh: (a) with batch-sharded latents in the program, GSPMD may
+        leave some updated-PARAM leaves sharded (deferring the gather)
+        — the next dispatch then sees different input shardings, so an
+        AOT-compiled step errors and a jit loop silently respecializes
+        every step; (b) under fsdp the sharded Adam moments propagate
+        forward through ``apply_updates`` and the new params/EMA come
+        out sharded, breaking donation aliasing AND handing the next
+        forward a full-param gather.  The pin makes the output layout
+        the contract's — XLA gathers the per-leaf UPDATES instead (the
+        declared ZeRO-1 cost under fsdp; a no-cost annotation when
+        everything is already replicated).  Skipped without an ambient
+        multi-device data axis, so single-device programs are
+        byte-identical to the unpinned jaxpr."""
+        n = ambient_data_size()
+        if n <= 1:
+            return st
+        from jax.sharding import PartitionSpec as P
+
+        from gansformer_tpu.parallel.contracts import (
+            fsdp_spec, state_leaf_role)
+
+        def pin(path, leaf):
+            if not hasattr(leaf, "shape"):
+                return leaf
+            role = state_leaf_role(path)
+            spec = (fsdp_spec(leaf.shape, n)
+                    if cfg.mesh.fsdp and role == "opt_state" else P())
+            return jax.lax.with_sharding_constraint(leaf, spec)
+
+        return jax.tree_util.tree_map_with_path(pin, st)
+
     def ema_beta_at(step: jax.Array) -> jax.Array:
         """Per-step EMA decay from the half-life in kimg (reference
         ema_kimg), with the optional ramp-up cap (reference ema_rampup:
@@ -141,7 +193,10 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
         ws = G.apply({"params": g_params}, z, label, method=Generator.map)
         if mix_rng is not None and t.style_mixing_prob > 0:
             k_z, k_cut, k_p = jax.random.split(mix_rng, 3)
-            z2 = jax.random.normal(k_z, z.shape, z.dtype)
+            # second mapping pass rides the same batch sharding as the
+            # primary latents (replicated key — see _sample_z)
+            z2 = constrain_data_axis(
+                jax.random.normal(k_z, z.shape, z.dtype))
             ws2 = G.apply({"params": g_params}, z2, label,
                           method=Generator.map)
             n, num_ws = ws.shape[0], ws.shape[1]
@@ -200,7 +255,8 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
                                   rng, label, do_r1)
         updates, d_opt = d_tx.update(grads, state.d_opt, state.d_params)
         d_params = optax.apply_updates(state.d_params, updates)
-        return state.replace(d_params=d_params, d_opt=d_opt), aux
+        return pin_state_layout(
+            state.replace(d_params=d_params, d_opt=d_opt)), aux
 
     # ---------------- G steps ----------------
 
@@ -247,10 +303,10 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
             lambda e, p: e * ema_beta + p * (1.0 - ema_beta),
             state.ema_params, g_params)
         w_avg = state.w_avg * w_avg_beta + w_batch_avg * (1.0 - w_avg_beta)
-        return state.replace(
+        return pin_state_layout(state.replace(
             step=state.step + batch,   # step counts images (kimg accounting)
             g_params=g_params, g_opt=g_opt, ema_params=ema_params,
-            w_avg=w_avg, pl_mean=new_pl_mean), aux
+            w_avg=w_avg, pl_mean=new_pl_mean)), aux
 
     # ---------------- fused lazy-reg cycle ----------------
 

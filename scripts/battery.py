@@ -87,22 +87,45 @@ def default_stages():
         #    findings — the discovery case) still counts as completed
         #    as long as the artifact was written, otherwise a real
         #    finding would re-burn 900 s in every window forever.
+        #    After the capture, diff the ranked comms table against the
+        #    checked-in expectation (COMMS_EXPECTED.json; ISSUE 7): the
+        #    train steps MUST show a gradient all-reduce on a multi-
+        #    device mesh.  The diff verdict lands in the window ledger
+        #    ({win}/comms_diff.json + battery.log) but does NOT gate
+        #    stage completion — capture beats verdict, same rationale
+        #    as the lint exit handling.
         stage("graftcomms", 900, "graftcomms_tpu.json",
               ["sh", "-c",
                f"{py} -m gansformer_tpu.analysis.cli --trace"
                f" --trace-native --trace-profile full --format json"
                f" --json-out .comms_attribution.json; rc=$?;"
+               f" {py} scripts/diff_comms.py .comms_attribution.json"
+               f" --json-out {{win}}/comms_diff.json;"
                f" [ $rc -le 1 ] && [ -s .comms_attribution.json ]"],
               copies=[(".comms_attribution.json",
                        "comms_attribution.json")]),
-        # 6. Batch sweep (the optional throughput upside).
+        # 6. Scaling-efficiency bench (ISSUE 7): the four phases on
+        #    data meshes of 1/2/4 chips (clamped to the window's
+        #    devices) — measured per-phase img/s/chip efficiency vs the
+        #    ring-model floor, collective inventory included.  Writes
+        #    the numbered MULTICHIP_r* round artifact; the stable copy
+        #    is preserved into the window (incrementally re-written per
+        #    mesh, so a timed-out stage still leaves the partial
+        #    capture).  Inner budget 700 < the 900 s stage budget —
+        #    ~90 s probe + shutdown headroom, same discipline as
+        #    bench_phases (600/780).
+        stage("bench_scaling", 900, "bench_scaling_tpu.json",
+              [py, "bench.py", "--scaling"],
+              env={"GRAFT_SCALING_TIMEOUT": "700"},
+              copies=[(".scaling_bench.json", "scaling_bench.json")]),
+        # 7. Batch sweep (the optional throughput upside).
         stage("bench_sweep", 1800, "bench_sweep_tpu.json", [py, "bench.py"],
               env={"GRAFT_BENCH_TPU_TIMEOUT": "1500",
                    "GRAFT_BENCH_SWEEP": "16,32"}),
-        # 7. Native-kernel record (Mosaic compile + parity).
+        # 8. Native-kernel record (Mosaic compile + parity).
         stage("pallas", 600, "pallas_tpu.json",
               [py, "scripts/bench_pallas_attention.py"]),
-        # 8. Real loop on the chip; stats.jsonl carries timing/mfu.
+        # 9. Real loop on the chip; stats.jsonl carries timing/mfu.
         stage("train_ticks", 1200, None,
               [py, "-m", "gansformer_tpu.cli.train",
                "--preset", "ffhq256-duplex", "--data-source", "synthetic",

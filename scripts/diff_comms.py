@@ -1,0 +1,119 @@
+"""Diff a graftcomms attribution artifact against the checked-in
+collective expectation (ISSUE 7 satellite).
+
+PR 6 *observed* that ``g_step``/``g_step_pl`` compiled to zero
+collectives (replicated compute); PR 7 fixed it and promoted the
+observation into expectations: ``COMMS_EXPECTED.json`` declares, per
+entry point, which collective kinds a multi-device capture MUST show
+(the four train steps + the fused cycle must all-reduce gradients) and
+which it must NOT (the inference programs must never all-gather params
+— forward compute with replicated params and a sharded batch needs no
+gather).  The battery's graftcomms stage runs this diff after every
+capture so a TPU window that silently regresses to replicated compute
+is called out in the window ledger, not discovered at the next
+re-anchor.
+
+Exit codes: 0 — capture matches (or is INCONCLUSIVE: a 1-chip window
+cannot show collectives and must not read as a regression); 1 —
+mismatch; 2 — usage/IO error.
+
+  python scripts/diff_comms.py [.comms_attribution.json]
+      [--expected COMMS_EXPECTED.json] [--json-out verdict.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_ARTIFACT = os.path.join(_REPO, ".comms_attribution.json")
+DEFAULT_EXPECTED = os.path.join(_REPO, "COMMS_EXPECTED.json")
+
+
+def short_name(entry: str) -> str:
+    tail = entry.split(".", 1)[1] if "." in entry else entry
+    return tail.split("[", 1)[0]
+
+
+def diff_comms(artifact: dict, expected: dict) -> dict:
+    """Pure verdict builder (unit-tested in tests/test_bench_artifacts):
+    ``{verdict: ok|mismatch|inconclusive, mismatches: [...], checked:
+    [...], note?}``."""
+    min_dev = int(expected.get("min_devices", 2))
+    compiled = [int(n) for n in artifact.get("mesh_sizes_compiled") or []]
+    if not compiled or max(compiled) < min_dev:
+        return {"verdict": "inconclusive", "mismatches": [], "checked": [],
+                "note": f"capture never compiled a >= {min_dev}-device "
+                        f"mesh (compiled: {compiled}) — a device-starved "
+                        f"window shows no collectives by construction; "
+                        f"re-run with devices"}
+    by_short = {}
+    for rec in artifact.get("comms") or []:
+        s = short_name(rec.get("entry", ""))
+        cur = by_short.get(s)
+        if cur is None or rec.get("devices", 0) > cur.get("devices", 0):
+            by_short[s] = rec
+    mismatches, checked = [], []
+    for name, want in (expected.get("entries") or {}).items():
+        rec = by_short.get(name)
+        if rec is None:
+            mismatches.append(f"{name}: not in the captured comms table "
+                              f"(entry skipped or renamed)")
+            continue
+        if rec.get("devices", 0) < min_dev:
+            mismatches.append(
+                f"{name}: largest captured mesh is "
+                f"{rec.get('devices')} device(s) (< {min_dev})")
+            continue
+        kinds = set(rec.get("collectives") or {})
+        for k in want.get("require_kinds", ()):
+            if k not in kinds:
+                mismatches.append(
+                    f"{name}: expected a {k} on the "
+                    f"{rec['devices']}-device mesh, captured kinds: "
+                    f"{sorted(kinds) or 'NONE (replicated compute)'}")
+        for k in want.get("forbid_kinds", ()):
+            if k in kinds:
+                mismatches.append(
+                    f"{name}: captured a {k} "
+                    f"({rec['collectives'][k]['payload_bytes']} B) — "
+                    f"forbidden for this entry (inference must not "
+                    f"gather params)")
+        checked.append(name)
+    return {"verdict": "mismatch" if mismatches else "ok",
+            "mismatches": mismatches, "checked": checked}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("artifact", nargs="?", default=DEFAULT_ARTIFACT)
+    p.add_argument("--expected", default=DEFAULT_EXPECTED)
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args(argv)
+    try:
+        with open(args.artifact) as f:
+            artifact = json.load(f)
+        with open(args.expected) as f:
+            expected = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"diff_comms: cannot read inputs: {e}", file=sys.stderr)
+        return 2
+    verdict = diff_comms(artifact, expected)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(verdict, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(f"diff_comms: {verdict['verdict']} "
+          f"({len(verdict['checked'])} entries checked)")
+    for m in verdict["mismatches"]:
+        print(f"  MISMATCH: {m}")
+    if verdict.get("note"):
+        print(f"  note: {verdict['note']}")
+    return 1 if verdict["verdict"] == "mismatch" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

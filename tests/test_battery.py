@@ -61,14 +61,17 @@ def test_default_stage_order_and_headline_budget():
     assert float(first["env"]["GRAFT_BENCH_TPU_TIMEOUT"]) <= 600
     assert first["budget_s"] <= 780
     for required in ("components", "ab_levers", "readiness_1024",
-                     "graftcomms"):
+                     "graftcomms", "bench_scaling"):
         assert required in names
         assert names.index(required) < names.index("bench_sweep")
-    # every {win} placeholder stays inside the window dir
+    # every {win} placeholder stays inside the window dir (each
+    # occurrence is a path component: "{win}/...")
+    import re
+
     for s in stages:
         for a in s["argv"]:
-            if "{win}" in a:
-                assert a.startswith("{win}/"), a
+            for m in re.finditer(r"\{win\}", a):
+                assert a[m.end():m.end() + 1] == "/", a
 
 
 def test_graftcomms_stage_captures_tpu_comms_table():
@@ -88,6 +91,26 @@ def test_graftcomms_stage_captures_tpu_comms_table():
     # the stage when the artifact exists — else it re-fires forever
     assert "[ $rc -le 1 ]" in argv
     assert "[ -s .comms_attribution.json ]" in argv
+    # ISSUE 7 satellite: the capture is diffed against the checked-in
+    # expectation, verdict recorded in the window ledger (not gating)
+    assert "scripts/diff_comms.py" in argv
+    assert "--json-out {win}/comms_diff.json" in argv
+
+
+def test_scaling_stage_runs_bench_scaling():
+    """ISSUE 7: the battery measures scaling efficiency on real chips —
+    bench.py --scaling before the optional sweep, stable artifact copy
+    preserved into the window ledger."""
+    stages = {s["name"]: s for s in battery.default_stages()}
+    st = stages["bench_scaling"]
+    assert "--scaling" in st["argv"]
+    assert "bench.py" in " ".join(st["argv"])
+    assert (".scaling_bench.json", "scaling_bench.json") \
+        in [tuple(c) for c in st["copies"]]
+    # inner budget leaves probe/shutdown headroom under the stage
+    # budget — else an over-budget window re-fires the stage forever
+    assert float(st["env"]["GRAFT_SCALING_TIMEOUT"]) <= \
+        st["budget_s"] - 150
 
 
 def test_default_probe_cmd_env_override(monkeypatch):

@@ -301,3 +301,165 @@ def test_load_comms_payload_tolerates_missing_and_torn(tmp_path):
     ok = tmp_path / "ok.json"
     ok.write_text(json.dumps(COMMS_PAYLOAD))
     assert bench._load_comms_payload(str(ok)) == COMMS_PAYLOAD
+
+
+# --- scaling bench (ISSUE 7): pure builder + diff ---------------------------
+
+def _mesh_rec(n, img_s_chip, phase_ms, wire=0, kinds=None):
+    phases = ("d", "g", "d_r1", "g_pl")
+    colls = {p: dict(kinds or {}) for p in phases}
+    return {
+        "devices": n, "global_batch": 4 * n, "per_chip_batch": 4,
+        "phase_ms": {p: phase_ms for p in phases},
+        "phase_gflops_per_device": {p: 1.0 for p in phases},
+        "img_per_sec_per_chip": {p: img_s_chip for p in phases},
+        "collectives": colls,
+        "wire_bytes_per_device": {p: wire for p in phases},
+        "comms_records": [
+            {"entry": f"steps.{e}[scaling]", "devices": n,
+             "collectives": dict(kinds or {}),
+             "total_payload_bytes": wire,
+             "total_wire_bytes_per_device": wire,
+             "param_bytes": 0, "opt_state_bytes": 0, "note": ""}
+            for e in ("d_step", "g_step", "d_step_r1", "g_step_pl")],
+    }
+
+
+AR = {"all-reduce": {"count": 3, "payload_bytes": 1_000_000,
+                     "wire_bytes_per_device": 1_000_000}}
+
+
+def test_build_scaling_artifact_efficiency_and_floor():
+    per_mesh = [_mesh_rec(1, 100.0, 10.0),
+                _mesh_rec(2, 90.0, 11.1, wire=1_000_000, kinds=AR)]
+    out = bench.build_scaling_artifact(
+        per_mesh, platform="tpu", device_kind="TPU v5 lite",
+        config_name="ffhq256-duplex", iters=10,
+        ici_bytes_per_s=1e9)
+    assert out["kind"] == "scaling_bench"
+    assert out["mesh_sizes"] == [1, 2]
+    assert out["per_phase_efficiency"]["2"]["d"] == pytest.approx(0.9)
+    # floor: t_comp = 10 ms, comms = 1 MB / 1 GB/s = 1 ms → 10/11
+    assert out["ring_floor_efficiency"]["2"]["d"] == pytest.approx(
+        10 / 11, abs=1e-3)
+    assert "suspect" not in out and "cpu_note" not in out
+    # graftcomms-payload-compatible: build_expected_scaling accepts it
+    assert out["mesh_sizes_compiled"] == [1, 2]
+    assert out["scaling_bytes_per_device"]
+    scal = bench.build_expected_scaling(
+        out, per_mesh[0]["phase_ms"], ici_bytes_per_s=1e9)
+    assert scal is not None
+    assert scal["per_phase_efficiency"]["d"]["2"] > 0.5
+
+
+def test_build_scaling_artifact_flags_replicated_phase_and_cpu():
+    per_mesh = [_mesh_rec(1, 100.0, 10.0),
+                _mesh_rec(2, 99.0, 10.1)]          # NO all-reduce at n=2
+    out = bench.build_scaling_artifact(
+        per_mesh, platform="cpu", device_kind="cpu",
+        config_name="scaling-micro", iters=2)
+    assert any("zero all-reduces" in s for s in out["suspect"])
+    assert "cpu_note" in out
+    single = bench.build_scaling_artifact(
+        [_mesh_rec(1, 100.0, 10.0)], platform="cpu", device_kind="cpu",
+        config_name="scaling-micro", iters=2)
+    assert any("single-device" in s for s in single["suspect"])
+    assert "per_phase_efficiency" not in single
+
+
+def test_build_scaling_artifact_empty_capture_is_honest():
+    """A device-starved run that measured NOTHING must emit an honest
+    artifact (requested vs compiled distinct, suspect note), not
+    crash."""
+    out = bench.build_scaling_artifact(
+        [], platform="tpu", device_kind="TPU v5 lite",
+        config_name="ffhq256-duplex", iters=10,
+        mesh_sizes_requested=[2, 4])
+    assert out["mesh_sizes_compiled"] == []
+    assert out["mesh_sizes_requested"] == [2, 4]
+    assert any("no mesh size" in s for s in out["suspect"])
+    # and requested-vs-compiled stays distinct on partial captures too
+    part = bench.build_scaling_artifact(
+        [_mesh_rec(1, 100.0, 10.0)], platform="cpu", device_kind="cpu",
+        config_name="m", iters=1, mesh_sizes_requested=[1, 2, 4])
+    assert part["mesh_sizes_requested"] == [1, 2, 4]
+    assert part["mesh_sizes_compiled"] == [1]
+
+
+def test_diff_comms_verdicts():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "diff_comms", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "diff_comms.py"))
+    dc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(dc)
+    expected = {"version": 1, "min_devices": 2,
+                "entries": {"g_step": {"require_kinds": ["all-reduce"]},
+                            "sample": {"forbid_kinds": ["all-gather"]}}}
+
+    def artifact(g_kinds, s_kinds, compiled=(1, 2)):
+        return {"mesh_sizes_compiled": list(compiled),
+                "comms": [
+                    {"entry": "steps.g_step[tiny-f32]", "devices": 2,
+                     "collectives": g_kinds},
+                    {"entry": "steps.sample[tiny-f32]", "devices": 2,
+                     "collectives": s_kinds}]}
+
+    ok = dc.diff_comms(artifact({"all-reduce": {"count": 1,
+                                                "payload_bytes": 8}}, {}),
+                       expected)
+    assert ok["verdict"] == "ok" and ok["checked"] == ["g_step", "sample"]
+    # the replicated-compute regression reads as a mismatch in words
+    bad = dc.diff_comms(artifact({}, {}), expected)
+    assert bad["verdict"] == "mismatch"
+    assert any("replicated compute" in m for m in bad["mismatches"])
+    # forbidden inference gather
+    gather = dc.diff_comms(
+        artifact({"all-reduce": {"count": 1, "payload_bytes": 8}},
+                 {"all-gather": {"count": 1, "payload_bytes": 512}}),
+        expected)
+    assert gather["verdict"] == "mismatch"
+    # a 1-chip window is INCONCLUSIVE (exit 0), never a false regression
+    inc = dc.diff_comms(artifact({}, {}, compiled=(1,)), expected)
+    assert inc["verdict"] == "inconclusive" and inc["mismatches"] == []
+
+
+def test_checked_in_comms_expectation_covers_every_entry():
+    """COMMS_EXPECTED.json names every catalog entry: the train steps +
+    cycle require a gradient all-reduce, the inference programs forbid
+    a param gather."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "COMMS_EXPECTED.json")) as f:
+        exp = json.load(f)
+    entries = exp["entries"]
+    for s in ("d_step", "d_step_r1", "g_step", "g_step_pl", "cycle"):
+        assert "all-reduce" in entries[s]["require_kinds"], s
+    for s in ("sample", "ppl_pairs"):
+        assert "all-gather" in entries[s]["forbid_kinds"], s
+    assert exp["min_devices"] >= 2
+
+
+@pytest.mark.slow
+def test_run_scaling_end_to_end_two_device_capture(tmp_path):
+    """ISSUE 7 acceptance: ``run_scaling`` (the --scaling core) on the
+    micro config at mesh 1+2 emits an artifact with a >= 2-device
+    capture that (a) shows the gradient all-reduce in every train
+    phase, (b) ``build_expected_scaling`` accepts, and (c) carries the
+    per-phase efficiency + ring-floor sections."""
+    from gansformer_tpu.analysis.trace.entry_points import tiny_config
+
+    out_path = str(tmp_path / "MULTICHIP_test.json")
+    cfg = tiny_config()
+    out = bench.run_scaling(cfg, (1, 2), per_chip_batch=4, iters=1,
+                            out_path=out_path)
+    assert out["mesh_sizes_compiled"] == [1, 2]
+    for ph, kinds in out["per_mesh"]["2"]["collectives"].items():
+        assert "all-reduce" in kinds, ph
+    assert "suspect" not in out
+    assert out["per_phase_efficiency"]["2"]
+    assert out["ring_floor_efficiency"]["2"]
+    saved = json.load(open(out_path))
+    assert bench.build_expected_scaling(
+        saved, saved["per_mesh"]["1"]["phase_ms"]) is not None

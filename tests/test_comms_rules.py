@@ -233,6 +233,55 @@ def test_collective_flow_baselined(tmp_path):
         tmp_path)
 
 
+def _replicated_trainer(s):
+    # a "train step" whose compute never touches a sharded batch: no
+    # all-reduce anywhere — the ISSUE 7 replicated-compute defect
+    return s * 0.99 + 1.0
+
+
+def _replicated_trainer_suppressed(s):  # graftlint: disable=collective-flow — fixture: suppression contract
+    return s * 0.99 + 1.0
+
+
+def _reducing_trainer(p, x):
+    # gradient-shaped: a mean over the sharded batch axis → all-reduce
+    return p - 1e-3 * x.mean(axis=0)
+
+
+def test_collective_flow_fires_on_replicated_train_step():
+    """ISSUE 7: a train_step entry compiling to ZERO all-reduces on a
+    multi-device data mesh is replicated compute — a finding, not a
+    table row."""
+    ep = ep_for(_replicated_trainer, MAT, contract=STATE_CONTRACT,
+                train_step=True)
+    findings, _ = run_one(CollectiveFlowRule, ep)
+    assert any("ZERO all-reduces" in f.message and f.new
+               for f in findings)
+
+
+def test_collective_flow_replicated_compute_quiet_cases():
+    """The check is train-step-scoped and presence-satisfied: inference
+    programs compile collective-free legitimately, and a train step
+    with a gradient all-reduce is clean."""
+    ep_inf = ep_for(_replicated_trainer, MAT, contract=STATE_CONTRACT)
+    findings, _ = run_one(CollectiveFlowRule, ep_inf)
+    assert findings == []                      # train_step=False → quiet
+    ep_ok = ep_for(_reducing_trainer, SMALLP, MAT,
+                   contract=Contract(args=("params", "batch"),
+                                     outs=("params",)),
+                   train_step=True)
+    findings, ctx = run_one(CollectiveFlowRule, ep_ok)
+    assert findings == []
+    assert ctx.comms[0]["collectives"]["all-reduce"]["count"] >= 1
+
+
+def test_collective_flow_replicated_compute_suppressed():
+    ep = ep_for(_replicated_trainer_suppressed, MAT,
+                contract=STATE_CONTRACT, train_step=True)
+    findings, _ = run_one(CollectiveFlowRule, ep)
+    assert findings and all(f.suppressed and not f.new for f in findings)
+
+
 def test_rules_share_one_compile_per_entry_mesh():
     """partition-contract and collective-flow compile the SAME
     contract-sharded program — the shared ctx cache must make the

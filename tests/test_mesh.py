@@ -122,3 +122,71 @@ def test_simulated_mesh_shape_matrix():
     assert env4.data_size == 2 and env4.model_size == 2
     with pytest.raises(ValueError):
         simulated_mesh(64)                    # more than the 8 virtual
+
+
+# --- ISSUE 7 satellite: local_batch_size / host-plan agreement ---------------
+
+class _FakeDevice:
+    """Stand-in device with a process_index (the only attribute the
+    per-process row math reads) — lets one test process simulate the
+    2-process ownership layout without a real coordinator."""
+
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+
+def _fake_two_process_env():
+    """4-device 4x1 data mesh, devices 0-1 on process 0, 2-3 on
+    process 1 (the contiguous layout jax.distributed produces)."""
+    import types
+
+    from gansformer_tpu.parallel.mesh import MeshEnv
+
+    devs = np.array([_FakeDevice(0), _FakeDevice(0),
+                     _FakeDevice(1), _FakeDevice(1)]).reshape(4, 1)
+    mesh = types.SimpleNamespace(
+        devices=devs, shape={DATA_AXIS: 4, MODEL_AXIS: 1},
+        axis_names=(DATA_AXIS, MODEL_AXIS))
+    return MeshEnv(mesh=mesh)
+
+
+def test_local_batch_size_matches_local_data_rows_two_process(monkeypatch):
+    """The prefetch plan's per-process share (loop.py feeds
+    ``local_batch_size`` rows per process) must equal
+    per-row-batch x ``MeshEnv.local_data_rows`` for EVERY process, and
+    the shares must partition the global batch."""
+    env = _fake_two_process_env()
+    shares = {}
+    for pid in (0, 1):
+        monkeypatch.setattr(jax, "process_index", lambda p=pid: p)
+        rows = env.local_data_rows
+        assert rows == 2, pid                  # 2 of the 4 data rows each
+        shares[pid] = local_batch_size(8, env)
+        assert shares[pid] == (8 // env.data_size) * rows
+    assert sum(shares.values()) == 8
+
+
+def test_global_batch_reassembles_bit_exact_from_process_shards():
+    """The addressing contract ``make_array_from_process_local_data``
+    relies on, held bit-exact on a REAL 4-device mesh: each (simulated)
+    process's local_batch_size rows, split per-data-row onto ITS
+    devices in mesh order, reassemble the exact global batch."""
+    env = env_of(4)
+    global_batch = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    per_row = 8 // env.data_size               # 2 rows per device
+    # simulated ownership: process p owns devices 2p, 2p+1 → its host
+    # shard is local_batch_size(8) = 4 contiguous rows
+    host = {0: global_batch[0:4], 1: global_batch[4:8]}
+    pieces = []
+    for d_idx, dev in enumerate(env.mesh.devices.flat):
+        pid, local_row = divmod(d_idx, 2)
+        piece = host[pid][local_row * per_row:(local_row + 1) * per_row]
+        pieces.append(jax.device_put(piece, dev))
+    arr = jax.make_array_from_single_device_arrays(
+        (8, 3), env.batch(), pieces)
+    np.testing.assert_array_equal(np.asarray(arr), global_batch)
+    # and the callback-assembly path (MeshEnv.put_global's multi-process
+    # branch) produces the same array from a full host copy
+    cb = jax.make_array_from_callback((8, 3), env.batch(),
+                                      lambda idx: global_batch[idx])
+    np.testing.assert_array_equal(np.asarray(cb), global_batch)

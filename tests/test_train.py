@@ -379,3 +379,78 @@ def test_fused_cycle_conditional_labels():
             acc[key], rel=1e-4, abs=1e-4), key
     assert int(jax.device_get(state_f.step)) == \
         int(jax.device_get(state_u.step))
+
+
+@pytest.mark.slow  # compiles the (d, g) pair on two mesh layouts
+def test_sharded_latents_data2_matches_data1():
+    """ISSUE 7 acceptance: with the in-step latent draws sharded onto
+    the data axis (steps._sample_z under an ambient mesh), a data=2 run
+    reproduces the data=1 run's losses and updated params to float-
+    reduction-order tolerance — the sharding is a layout change, not a
+    math change.  (Bit-identity at data=1 is structural: the constraint
+    is skipped entirely without a multi-device data axis.)"""
+    imgs_np = np.random.RandomState(0).randint(
+        0, 255, (8, 16, 16, 3), dtype=np.uint8)
+    rng = jax.random.PRNGKey(7)
+    results = {}
+    for n in (1, 2):
+        cfg = micro_cfg(batch=8)
+        cfg = dataclasses.replace(cfg, mesh=MeshConfig(data=n))
+        env = make_mesh(cfg.mesh, devices=jax.devices()[:n])
+        state = jax.device_put(create_train_state(cfg, jax.random.PRNGKey(0)),
+                               env.replicated())
+        fns = make_train_steps(cfg, env, batch_size=8)
+        imgs = jax.device_put(imgs_np, env.batch())
+        aux_all = {}
+        with env.activate():
+            for it in range(2):
+                r = jax.random.fold_in(rng, it)
+                state, d_aux = fns.d_step(state, imgs,
+                                          jax.random.fold_in(r, 0))
+                state, g_aux = fns.g_step(state, jax.random.fold_in(r, 1))
+                for key, v in {**d_aux, **g_aux}.items():
+                    aux_all[f"{it}/{key}"] = float(jax.device_get(v))
+            jax.block_until_ready(state.step)
+        results[n] = (jax.device_get(state.g_params), aux_all)
+    p1, a1 = results[1]
+    p2, a2 = results[2]
+    # The loss trajectory is THE parity signal: iteration 1's losses
+    # already reflect iteration 0's updated params on both meshes.
+    for key in a1:
+        assert a1[key] == pytest.approx(a2[key], rel=2e-4, abs=1e-5), key
+    # Params get a loose gate only: Adam's first steps are ~sign(g)·lr,
+    # so float-reduction-order noise on near-zero gradients legitimately
+    # moves single elements by a fraction of one update (lr·c ≈ 2e-3);
+    # what this must catch is WRONG math (order-of-magnitude divergence).
+    for x, y in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-2, atol=1e-3)
+
+
+def test_metric_sampler_outputs_shard_on_data_axis():
+    """ISSUE 7 satellite (steps.py make_metric_samplers): the metric
+    sweep's generator half must actually shard at 2+ devices — z lands
+    via env.put_global on the data axis and the sampled images come
+    back data-sharded (2 devices hold disjoint shards), so a 50k sweep
+    is batch-parallel, not replicated."""
+    from gansformer_tpu.data.dataset import make_dataset
+    from gansformer_tpu.train.steps import make_metric_samplers
+
+    cfg = micro_cfg(batch=4)
+    cfg = dataclasses.replace(cfg, mesh=MeshConfig(data=2))
+    env = make_mesh(cfg.mesh, devices=jax.devices()[:2])
+    state = jax.device_put(create_train_state(cfg, jax.random.PRNGKey(0)),
+                           env.replicated())
+    fns = make_train_steps(cfg, env, batch_size=4)
+    dataset = make_dataset(cfg.data)
+    with env.activate():
+        sample_fn, pair_fn = make_metric_samplers(
+            fns, state, cfg, env, dataset, truncation_psi=1.0, seed=11)
+        out = sample_fn(4)
+        jax.block_until_ready(out)
+    assert out.shape == (4, 16, 16, 3)
+    assert not out.sharding.is_fully_replicated
+    assert len(out.sharding.device_set) == 2
+    # each device holds a half-batch shard, not a full copy
+    assert {s.data.shape[0] for s in out.addressable_shards} == {2}
