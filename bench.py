@@ -105,7 +105,7 @@ def build_phase_artifact(*, metric: str, on_tpu: bool, n_chips: int,
                          fetch_s: dict, compile_s: dict, identity: dict,
                          peak, d_reg_interval: int, g_reg_interval: int,
                          iters: int, linearity: dict, device_kind: str,
-                         partial: bool) -> dict:
+                         partial: bool, device_ms: dict = None) -> dict:
     """Measurement numbers → the phase-weighted artifact dict (VERDICT r4
     weak #4: the logic that decides whether a number is real, as a PURE
     function on plain dicts — unit-testable without a device).
@@ -145,6 +145,8 @@ def build_phase_artifact(*, metric: str, on_tpu: bool, n_chips: int,
     if flops:
         out["phase_gflops_per_chip"] = {
             k: round(v / 1e9, 1) for k, v in flops.items()}
+    if device_ms:
+        attach_device_ms(out, device_ms, flops, peak)
     if peak:
         out["peak_bf16_tflops_per_chip"] = peak
         out["phase_mfu"] = {
@@ -161,6 +163,24 @@ def build_phase_artifact(*, metric: str, on_tpu: bool, n_chips: int,
         out["suspect"] = sus
     if partial:
         out["partial"] = "reg variants not yet measured"
+    return out
+
+
+def attach_device_ms(out: dict, device_ms: dict, flops: dict,
+                     peak) -> dict:
+    """Profiler-derived per-iteration DEVICE time next to the wall
+    number (ISSUE 8): wall ms is what the host clock claims, device ms
+    is what the chip executed — the r3 retraction is the reason both
+    ride the artifact.  THE one place that formats ``phase_device_ms``
+    / ``phase_device_mfu`` (pure; ``build_phase_artifact`` and the
+    trace witness both call it, so the tested path IS the shipped
+    path).  Mutates and returns ``out``."""
+    out["phase_device_ms"] = {k: round(v, 2) for k, v in device_ms.items()}
+    if peak:
+        mfu = {k: round(flops[k] / (device_ms[k] / 1e3) / (peak * 1e12), 4)
+               for k in device_ms if k in flops and device_ms[k] > 0}
+        if mfu:
+            out["phase_device_mfu"] = mfu
     return out
 
 
@@ -207,6 +227,23 @@ def build_expected_scaling(comms_payload: dict, phase_ms: dict,
         "per_phase_efficiency": per_phase,
         "comms_profile": comms_payload.get("trace_profile"),
     }
+
+
+def _hbm_snapshot():
+    """Max-over-local-devices HBM stats right now (the same aggregation
+    the heartbeat records — ``obs/heartbeat.hbm_device_stats``), or None
+    on backends that don't report (CPU).  Attached fresh at every
+    artifact emission so ``hbm.peak_bytes`` reflects the programs
+    actually measured — the FFHQ-1024 fit evidence (ISSUE 8
+    satellite)."""
+    try:
+        from gansformer_tpu.obs.heartbeat import hbm_device_stats
+    except Exception:
+        return None
+    out = hbm_device_stats()
+    if out is not None and not out["bytes_limit"]:
+        out = {k: v for k, v in out.items() if k != "bytes_limit"}
+    return out
 
 
 def _load_comms_payload(path: str = None):
@@ -723,6 +760,9 @@ class _BenchSession:
                 scal = build_expected_scaling(comms, out["phase_ms"])
                 if scal is not None:
                     out["expected_scaling"] = scal
+        hbm = _hbm_snapshot()
+        if hbm is not None:
+            out["hbm"] = hbm
         self.last_out.clear()
         self.last_out.update(out)
         print(json.dumps(out), flush=True)
@@ -1019,11 +1059,16 @@ class _BenchSession:
                               fmap_base=64, fmap_max=32,
                               attention="simplex", attn_start_res=8,
                               attn_max_res=8, mbstd_group_size=4),
+            # device_time_ticks=0: the probe measures the LOOP's
+            # host-side overlap behavior — a traced tick would inflate
+            # exactly the data_wait/h2d evidence it exists to capture
+            # (and pay the profiler's one-time init inside the budget)
             train=TrainConfig(batch_size=bsz, total_kimg=2,
                               kimg_per_tick=1, d_reg_interval=2,
                               g_reg_interval=2, pl_batch_shrink=2,
                               ema_kimg=0.01, snapshot_ticks=1,
-                              image_snapshot_ticks=0, metric_ticks=0),
+                              image_snapshot_ticks=0, metric_ticks=0,
+                              device_time_ticks=0),
             data=DataConfig(resolution=16, source="synthetic"),
             mesh=MeshConfig())
         d = tempfile.mkdtemp(prefix="graft_tick_probe_")
@@ -1122,6 +1167,15 @@ class _BenchSession:
             if self.last_out:
                 out = dict(self.last_out)
                 out["device_trace"] = tc
+                # device_ms next to the wall phase_ms (ISSUE 8): the
+                # witness traced n_tr iterations of the d program, so
+                # busy/n_tr is the per-iteration DEVICE time for that
+                # phase — the number the wall clock must answer to.
+                if busy > 0:
+                    attach_device_ms(
+                        out, {"d": busy / n_tr * 1e3},
+                        self.phase_results.get(bsz, ({}, {}))[1],
+                        self.peak)
                 ts = trace_suspect(busy, wall_tr, n_tr, t_d)
                 if ts:
                     out["suspect"] = out.get("suspect", []) + [ts]

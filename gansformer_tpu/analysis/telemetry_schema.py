@@ -103,6 +103,53 @@ def check_prom(path: str) -> List[str]:
     return errors
 
 
+def check_metric_families(path: str) -> List[str]:
+    """Device-truth metric families (ISSUE 8): telemetry.prom must
+    answer "is device truth being measured?" EXPLICITLY — either with
+    the family's gauges or with its off/unavailable marker, never by
+    silent absence (absence would be indistinguishable from "the wiring
+    rotted").
+
+    * ``device/*`` — ``device_sampler_off`` marker always; when the
+      sampler is on and a sample landed, the divergence gauge
+      ``device_wall_busy_ratio`` + ``device_busy_ms`` must exist.
+    * ``hbm/*`` — ``hbm_unavailable`` marker always; when the backend
+      reports (0.0), ``hbm_bytes_in_use`` + ``hbm_peak_bytes``.
+    * ``compile/*`` — ``compile_compiles_total`` (materialized at
+      listener install) and ``compile_retraces_total`` (materialized at
+      the tick-0 arm).
+    """
+    from gansformer_tpu.obs.registry import parse_prom_values
+
+    vals = parse_prom_values(path)
+    errors = []
+    if "device_sampler_off" not in vals:
+        errors.append(f"{path}: missing device/* family — no "
+                      f"device_sampler_off marker (is the device-time "
+                      f"sampler wired?)")
+    elif vals["device_sampler_off"] == 0.0:
+        if "device_samples_total" not in vals:
+            errors.append(f"{path}: device sampler on but no "
+                          f"device_samples_total counter")
+        elif vals["device_samples_total"] > 0 and (
+                "device_wall_busy_ratio" not in vals
+                or "device_busy_ms" not in vals):
+            errors.append(f"{path}: device sample landed but the "
+                          f"divergence gauges (device_wall_busy_ratio/"
+                          f"device_busy_ms) are missing")
+    if "hbm_unavailable" not in vals:
+        errors.append(f"{path}: missing hbm/* family — no "
+                      f"hbm_unavailable marker")
+    elif vals["hbm_unavailable"] == 0.0 and (
+            "hbm_bytes_in_use" not in vals or "hbm_peak_bytes" not in vals):
+        errors.append(f"{path}: backend reports memory but "
+                      f"hbm_bytes_in_use/hbm_peak_bytes are missing")
+    for name in ("compile_compiles_total", "compile_retraces_total"):
+        if name not in vals:
+            errors.append(f"{path}: missing {name}")
+    return errors
+
+
 def check_heartbeat(path: str) -> List[str]:
     errors = []
     try:
@@ -132,6 +179,8 @@ def check_run_dir(run_dir: str) -> dict:
             continue
         checked.append(fname)
         errors += checker(path)
+        if fname == "telemetry.prom":
+            errors += check_metric_families(path)
     beats = sorted(glob.glob(os.path.join(run_dir, "heartbeat-p*.json")))
     if not beats:
         errors.append(f"{run_dir}: no heartbeat-p*.json")

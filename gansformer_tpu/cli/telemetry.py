@@ -1,14 +1,21 @@
 """Telemetry CLI — inspect a run dir's observability artifacts.
 
-Three subcommands over the files the train loop writes
+Four subcommands over the files the train loop writes
 (docs/observability.md):
 
   trace       events.jsonl → Chrome-trace JSON (open in chrome://tracing
               or https://ui.perfetto.dev)
-  heartbeats  staleness probe over heartbeat-p*.json; exit 1 when any
-              peer is stale/missing (babysitter-scriptable)
+  heartbeats  staleness + step-skew probe over heartbeat-p*.json; exit 1
+              when any peer is stale/missing/straggling
+              (babysitter-scriptable)
   summary     per-phase totals aggregated from events.jsonl + the
               current telemetry.prom
+  doctor      one run-health report cross-checking ALL of it (ISSUE 8):
+              device-time vs wall-clock MFU, wall-vs-device divergence,
+              data-wait fraction, queue depths, retraces, HBM headroom,
+              heartbeat staleness + per-process step skew, restart
+              count.  PASS/WARN/FAIL lines; --json for the
+              machine-readable form; exit 0 iff no FAIL.
 
 Examples
 --------
@@ -16,6 +23,9 @@ Examples
   python -m gansformer_tpu.cli.telemetry heartbeats results/00003-run \\
       --max-age 120 --expected 4
   python -m gansformer_tpu.cli.telemetry summary results/00003-run
+  python -m gansformer_tpu.cli.telemetry doctor results/00003-run
+  python -m gansformer_tpu.cli.telemetry doctor results \\
+      --json-out doctor.json          # picks the latest numbered run
 """
 
 from __future__ import annotations
@@ -24,7 +34,7 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 def read_events(run_dir: str) -> List[dict]:
@@ -75,6 +85,341 @@ def summarize_events(events: List[dict]) -> List[dict]:
         key=lambda r: -r["total_ms"])
 
 
+# --- doctor (ISSUE 8 tentpole c) --------------------------------------------
+
+
+def read_stats_records(run_dir: str) -> List[dict]:
+    """stats.jsonl tick records, torn-line-tolerant (same rationale as
+    read_events: crashed runs are the interesting ones)."""
+    path = os.path.join(run_dir, "stats.jsonl")
+    out: List[dict] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def read_prom_values(run_dir: str) -> Dict[str, float]:
+    """A run dir's telemetry.prom → {prom name: value}, empty when the
+    file is absent (the parser itself lives with the format's writer,
+    ``obs/registry.parse_prom_values``)."""
+    path = os.path.join(run_dir, "telemetry.prom")
+    if not os.path.exists(path):
+        return {}
+    from gansformer_tpu.obs.registry import parse_prom_values
+
+    return parse_prom_values(path)
+
+
+def resolve_run_dir(path: str) -> str:
+    """Accept either a run dir or a results root: when ``path`` has no
+    telemetry artifacts but contains numbered run dirs, descend to the
+    latest one (the battery points the doctor at ``{win}/train_tpu``)."""
+    if os.path.exists(os.path.join(path, "stats.jsonl")) or \
+            os.path.exists(os.path.join(path, "telemetry.prom")):
+        return path
+    from gansformer_tpu.utils.logging import list_run_dirs
+
+    runs = list_run_dirs(path)
+    return runs[-1] if runs else path
+
+
+class _Tele:
+    """Unified accessor over the LAST tick's registry snapshot (from
+    stats.jsonl, the rich source) with a telemetry.prom fallback for run
+    dirs that died before a full tick record landed.  Lookups use the
+    registry's slash names; the prom fallback translates through
+    ``prom_name``."""
+
+    def __init__(self, run_dir: str):
+        records = read_stats_records(run_dir)
+        self.last = records[-1] if records else {}
+        self.n_ticks = sum(1 for r in records
+                           if "timing/sec_per_tick" in r)
+        snap = self.last.get("telemetry", {})
+        self.counters = dict(snap.get("counters", {}))
+        self.gauges = dict(snap.get("gauges", {}))
+        self.histograms = dict(snap.get("histograms", {}))
+        self._prom = read_prom_values(run_dir)
+        self.have_any = bool(snap) or bool(self._prom)
+
+    def _get(self, table: dict, name: str):
+        if name in table:
+            return table[name]
+        from gansformer_tpu.obs.registry import prom_name
+
+        return self._prom.get(prom_name(name))
+
+    def counter(self, name: str):
+        return self._get(self.counters, name)
+
+    def gauge(self, name: str):
+        return self._get(self.gauges, name)
+
+    def stat(self, name: str):
+        return self.last.get(name)
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
+               expected: Optional[int] = None,
+               max_step_skew: Optional[int] = None,
+               now: Optional[float] = None) -> dict:
+    """The run-health report as a pure-ish dict (rendered by
+    ``render_doctor``; archived verbatim by ``--json``).
+
+    Levels: PASS (healthy / informational), WARN (suspicious — the run
+    may still be fine, a human should look), FAIL (the run dir cannot be
+    trusted or a liveness contract is broken).  ``ok`` is True iff no
+    FAIL — WARNs never fail the doctor, so it is safe in gates that only
+    guard against hard breakage (the battery archives the JSON either
+    way)."""
+    checks: List[dict] = []
+
+    def check(name: str, level: str, detail: str) -> None:
+        checks.append({"name": name, "level": level, "detail": detail})
+
+    # -- artifacts ----------------------------------------------------------
+    present = [f for f in ("stats.jsonl", "telemetry.prom", "events.jsonl",
+                           "config.json")
+               if os.path.exists(os.path.join(run_dir, f))]
+    import glob as _glob
+
+    beats_files = _glob.glob(os.path.join(run_dir, "heartbeat-p*.json"))
+    if beats_files:
+        present.append(f"heartbeat-p*.json x{len(beats_files)}")
+    if "stats.jsonl" not in present and "telemetry.prom" not in present:
+        check("artifacts", "FAIL",
+              f"neither stats.jsonl nor telemetry.prom under {run_dir} — "
+              f"not a run dir this framework's loop wrote")
+        return {"run_dir": run_dir, "ok": False, "n_warn": 0, "n_fail": 1,
+                "checks": checks}
+    check("artifacts", "PASS", "found " + ", ".join(present))
+
+    tele = _Tele(run_dir)
+
+    # -- progress -----------------------------------------------------------
+    if tele.n_ticks:
+        check("progress", "PASS",
+              "{} tick(s), kimg {:.1f}, {:.1f} img/s/chip, "
+              "sec/tick {:.1f}".format(
+                  tele.n_ticks, tele.stat("Progress/kimg") or 0.0,
+                  tele.stat("timing/img_per_sec_per_chip") or 0.0,
+                  tele.stat("timing/sec_per_tick") or 0.0))
+    else:
+        check("progress", "WARN",
+              "no tick records in stats.jsonl — the run died before its "
+              "first tick boundary")
+
+    # -- device truth (wall-vs-device divergence) ---------------------------
+    sampler_off = tele.gauge("device/sampler_off")
+    samples = tele.counter("device/samples_total") or 0.0
+    ratio = tele.gauge("device/wall_busy_ratio")
+    if sampler_off == 1.0:
+        check("device_truth", "WARN",
+              "device-time sampler OFF — wall-clock numbers are "
+              "unverified (enable with --device-time-ticks N)")
+    elif sampler_off is None and ratio is None:
+        check("device_truth", "WARN",
+              "no device/* telemetry — run predates the device-truth "
+              "layer or never wrote a tick")
+    elif not samples or ratio is None:
+        unavailable = tele.gauge("device/unavailable")
+        check("device_truth", "WARN",
+              "sampler on but no device sample landed"
+              + (" (no trace parser available)"
+                 if unavailable == 1.0 else
+                 " yet (run shorter than the sampling cadence?)"))
+    elif ratio > 1.1:
+        check("device_truth", "WARN",
+              f"device busy exceeds sampled wall (ratio {ratio:.2f}) — "
+              f"the wall clock is NOT covering device execution (the "
+              f"retracted-r3 failure mode); distrust wall-clock numbers")
+    elif ratio < 0.25:
+        check("device_truth", "WARN",
+              f"device busy only {ratio:.0%} of the sampled tick — the "
+              f"device is mostly idle (host-bound run); check data_wait "
+              f"and dispatch overhead")
+    else:
+        check("device_truth", "PASS",
+              "device busy/wall ratio {:.2f} over {} sample(s) (busy "
+              "{:.0f} ms / wall {:.0f} ms)".format(
+                  ratio, int(samples), tele.gauge("device/busy_ms") or 0,
+                  tele.gauge("device/wall_ms") or 0))
+
+    # -- MFU: device-time beside wall-clock ---------------------------------
+    wall_mfu = tele.stat("timing/mfu")
+    dev_mfu = tele.gauge("device/mfu")
+    if wall_mfu is None and dev_mfu is None:
+        check("mfu", "PASS",
+              "no MFU bookkeeping (off-TPU or FLOPs unavailable)")
+    elif dev_mfu is None:
+        check("mfu", "WARN",
+              f"wall-clock MFU {wall_mfu:.3f} with NO device-time MFU to "
+              f"check it against — the number of record is device-time "
+              f"MFU (PERF.md measurement discipline)")
+    elif wall_mfu is None:
+        check("mfu", "PASS", f"device-time MFU {dev_mfu:.3f}")
+    elif abs(wall_mfu - dev_mfu) > 0.25 * max(dev_mfu, 1e-9):
+        check("mfu", "WARN",
+              f"wall-clock MFU {wall_mfu:.3f} diverges from device-time "
+              f"MFU {dev_mfu:.3f} (>25%) — trust the device number")
+    else:
+        check("mfu", "PASS",
+              f"device-time MFU {dev_mfu:.3f} agrees with wall-clock "
+              f"{wall_mfu:.3f}")
+
+    # -- input pipeline -----------------------------------------------------
+    wait_frac = tele.stat("timing/data_wait_frac")
+    if wait_frac is None:
+        check("data_wait", "WARN", "no timing/data_wait_frac stat")
+    elif wait_frac > 0.25:
+        check("data_wait", "WARN",
+              f"loop blocked on input {wait_frac:.0%} of the last tick — "
+              f"input-bound (decode or transfer, see queue depths)")
+    else:
+        check("data_wait", "PASS",
+              f"data wait {wait_frac:.1%} of the last tick")
+    starved = tele.counter("data/starved_total") or 0.0
+    depth = tele.gauge("data/prefetch_queue_depth")
+    dev_depth = tele.gauge("data/device_queue_depth")
+    qdetail = "host queue depth {}, device ring depth {}".format(
+        "?" if depth is None else int(depth),
+        "?" if dev_depth is None else int(dev_depth))
+    if starved > 0:
+        check("queues", "WARN",
+              f"data/starved_total = {int(starved)} (consumer beat the "
+              f"producer); {qdetail}")
+    else:
+        check("queues", "PASS", f"no starvation; {qdetail}")
+
+    # -- compiles / retraces ------------------------------------------------
+    compiles = tele.counter("compile/compiles_total")
+    retraces = tele.counter("compile/retraces_total")
+    if retraces is None:
+        check("compiles", "WARN",
+              "no compile/retraces_total — the retrace watch never "
+              "armed (run died before its first tick boundary?)")
+    elif retraces > 0:
+        check("compiles", "WARN",
+              f"{int(retraces)} post-warm-up compile(s) (retraces) — "
+              f"equivalent work re-entering the compiler mid-run "
+              f"(caveat: the first in-loop metric sweep compiles lazily "
+              f"and shows as a one-time jump)")
+    else:
+        check("compiles", "PASS",
+              "0 retraces ({} warm-up compile(s))".format(
+                  "?" if compiles is None else int(compiles)))
+
+    # -- HBM ----------------------------------------------------------------
+    hbm_unavail = tele.gauge("hbm/unavailable")
+    peak = tele.gauge("hbm/peak_bytes")
+    limit = tele.gauge("hbm/bytes_limit")
+    if hbm_unavail == 1.0:
+        check("hbm", "PASS",
+              "backend reports no memory stats (CPU) — hbm/* marked "
+              "unavailable")
+    elif peak is None:
+        check("hbm", "WARN", "no hbm/* telemetry in the run dir")
+    elif limit and peak / limit > 0.92:
+        check("hbm", "WARN",
+              f"peak HBM {_fmt_bytes(peak)} is {peak / limit:.0%} of the "
+              f"{_fmt_bytes(limit)} limit — one allocation from OOM")
+    else:
+        check("hbm", "PASS",
+              f"peak HBM {_fmt_bytes(peak)}"
+              + (f" of {_fmt_bytes(limit)} ({peak / limit:.0%})"
+                 if limit else ""))
+
+    # -- heartbeats: staleness + step skew ----------------------------------
+    from gansformer_tpu.obs.heartbeat import check_heartbeats
+
+    hb = check_heartbeats(
+        run_dir, max_age_s=max_age_s if max_age_s is not None else 1e18,
+        expected=list(range(expected)) if expected is not None else None,
+        now=now, max_step_skew=max_step_skew)
+    if hb["stale"] or hb["missing"]:
+        # missing peers (via --expected) must outrank the softer
+        # "no files" verdict: a fully-dead run is worse, not better,
+        # than a partially-dead one
+        check("heartbeats", "FAIL",
+              "stale processes {}, missing {}{} — babysitter should "
+              "restart".format(
+                  hb["stale"], hb["missing"],
+                  f" (max age {max_age_s}s)"
+                  if max_age_s is not None else ""))
+    elif not hb["ages"]:
+        check("heartbeats", "WARN", "no heartbeat files")
+    else:
+        age = max(hb["ages"].values())
+        check("heartbeats", "PASS",
+              f"{len(hb['ages'])} process(es), last beat {age:.0f}s ago"
+              + ("" if max_age_s is not None
+                 else " (no --max-age given: staleness not judged)"))
+    if len(hb.get("steps", {})) > 1:
+        if hb["skew_exceeded"]:
+            check("step_skew", "WARN",
+                  f"inter-process step skew {hb['step_skew']} > "
+                  f"{max_step_skew} — straggler (one process lags the "
+                  f"collectives); steps: {hb['steps']}")
+        else:
+            check("step_skew", "PASS",
+                  f"inter-process step skew {hb['step_skew']}"
+                  + ("" if max_step_skew is not None
+                     else " (no --max-skew given: not judged)"))
+
+    # -- restarts (availability evidence) -----------------------------------
+    from gansformer_tpu.utils.logging import read_resume_records
+
+    resumes = read_resume_records(run_dir)
+    if resumes:
+        check("restarts", "PASS",
+              f"{len(resumes)} restart(s); last resumed at step "
+              f"{resumes[-1].get('step', '?')}")
+    else:
+        check("restarts", "PASS", "no restarts recorded")
+
+    # -- device phase table (informational) ---------------------------------
+    phase_ms = sorted(((k.split("/", 2)[2], v)
+                       for k, v in tele.gauges.items()
+                       if k.startswith("device/phase_ms/")),
+                      key=lambda kv: -kv[1])
+    if phase_ms:
+        check("device_phases", "PASS",
+              "device ms (last sampled tick): " + ", ".join(
+                  f"{n}={v:.0f}" for n, v in phase_ms[:8]))
+
+    n_warn = sum(1 for c in checks if c["level"] == "WARN")
+    n_fail = sum(1 for c in checks if c["level"] == "FAIL")
+    return {"run_dir": run_dir, "ok": n_fail == 0,
+            "n_warn": n_warn, "n_fail": n_fail, "checks": checks}
+
+
+def render_doctor(report: dict) -> str:
+    lines = [f"run doctor: {report['run_dir']}"]
+    for c in report["checks"]:
+        lines.append(f"  {c['level']:<4s} {c['name']}: {c['detail']}")
+    lines.append("verdict: {} ({} warn, {} fail)".format(
+        "OK" if report["ok"] else "NOT OK",
+        report["n_warn"], report["n_fail"]))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -90,9 +435,34 @@ def main(argv=None) -> None:
                    help="seconds before a heartbeat counts as stale")
     h.add_argument("--expected", type=int, default=None,
                    help="expected process count (detects missing peers)")
+    h.add_argument("--max-skew", type=int, default=None,
+                   help="max inter-process step skew before the probe "
+                        "fails (straggler detection)")
 
     s = sub.add_parser("summary", help="phase totals + current telemetry")
     s.add_argument("run_dir")
+
+    d = sub.add_parser("doctor", help="one-shot run-health report "
+                                      "(PASS/WARN/FAIL; exit 0 iff no "
+                                      "FAIL)")
+    d.add_argument("run_dir",
+                   help="run dir, or a results root (picks the latest "
+                        "numbered run)")
+    d.add_argument("--json", action="store_true",
+                   help="print the machine-readable report instead of "
+                        "the rendered one")
+    d.add_argument("--json-out", default=None, metavar="PATH",
+                   help="also write the JSON report to PATH (the "
+                        "battery archives one per window)")
+    d.add_argument("--max-age", type=float, default=None,
+                   help="judge heartbeat staleness against this many "
+                        "seconds (stale → FAIL); default: report only")
+    d.add_argument("--expected", type=int, default=None,
+                   help="expected process count (missing peers → FAIL)")
+    d.add_argument("--max-skew", type=int, default=None,
+                   help="judge inter-process step skew against this "
+                        "threshold (exceeded → WARN); default: report "
+                        "only")
 
     args = p.parse_args(argv)
 
@@ -106,9 +476,25 @@ def main(argv=None) -> None:
         expected = (list(range(args.expected))
                     if args.expected is not None else None)
         result = check_heartbeats(args.run_dir, max_age_s=args.max_age,
-                                  expected=expected)
+                                  expected=expected,
+                                  max_step_skew=args.max_skew)
         print(json.dumps(result))
         if not result["ok"]:
+            sys.exit(1)
+    elif args.cmd == "doctor":
+        run_dir = resolve_run_dir(args.run_dir)
+        report = run_doctor(run_dir, max_age_s=args.max_age,
+                            expected=args.expected,
+                            max_step_skew=args.max_skew)
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+                f.write("\n")
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            print(render_doctor(report))
+        if not report["ok"]:
             sys.exit(1)
     elif args.cmd == "summary":
         for row in summarize_events(read_events(args.run_dir)):

@@ -108,6 +108,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="jax.profiler trace of tick 1 → this dir "
                         "(TensorBoard profile plugin)")
+    p.add_argument("--device-time-ticks", type=int, default=None,
+                   help="device-truth sampling cadence: every N ticks, "
+                        "trace one full tick with jax.profiler and fold "
+                        "device/* gauges (device-time MFU, per-program "
+                        "device ms, wall-vs-device divergence) into "
+                        "telemetry.prom.  0 = off (use 0 for unattended "
+                        "relayed-TPU runs — a killed trace can wedge the "
+                        "tunnel); default 8")
     # data overrides
     p.add_argument("--data-path", default=None)
     p.add_argument("--data-source",
@@ -175,7 +183,9 @@ def config_from_args(args) -> ExperimentConfig:
                      total_kimg=args.total_kimg, g_lr=args.g_lr,
                      d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed,
                      pl_batch_shrink=getattr(args, "pl_batch_shrink", None),
-                     r1_batch_shrink=getattr(args, "r1_batch_shrink", None))
+                     r1_batch_shrink=getattr(args, "r1_batch_shrink", None),
+                     device_time_ticks=getattr(args, "device_time_ticks",
+                                               None))
     fc = getattr(args, "fused_cycle", None)
     if fc is not None:                # tri-state: None inherits the config
         train = dataclasses.replace(train, fused_cycle=fc)

@@ -218,6 +218,16 @@ class TrainConfig:
     # (steady state — past all compiles) written here for TensorBoard's
     # profile plugin.  None = off.
     profile_dir: Optional[str] = None
+    # Device-truth sampling (ISSUE 8): every N ticks, wrap one full tick
+    # window in a jax.profiler trace, parse it (utils/profparse.py), and
+    # fold device/* gauges into telemetry (device-time MFU, per-program
+    # device ms, the wall-vs-device divergence ratio).  0 = off.  The
+    # default cadence (1 tick traced in 8) keeps the amortized overhead
+    # small; unattended relayed-TPU runs should pass 0 — a client killed
+    # mid-trace was observed to wedge the tunnel's backend claim
+    # (bench.py r4 note).  Mutually exclusive with profile_dir at
+    # runtime: the one-shot trace owns the (process-global) profiler.
+    device_time_ticks: int = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -325,6 +335,9 @@ class ExperimentConfig:
         elif t.batch_size % t.pl_batch_shrink:
             errs.append(f"pl_batch_shrink ({t.pl_batch_shrink}) must divide "
                         f"batch_size ({t.batch_size})")
+        if t.device_time_ticks < 0:
+            errs.append(f"device_time_ticks must be ≥ 0 (0 = off), got "
+                        f"{t.device_time_ticks}")
         if t.r1_batch_shrink < 1:
             errs.append(f"r1_batch_shrink must be ≥ 1, got "
                         f"{t.r1_batch_shrink}")

@@ -10,16 +10,26 @@ Three layers, each importable without jax/tensorflow so host-side tools
 * ``registry`` — process-global counters / gauges / histograms with a
   Prometheus-style text export (``telemetry.prom``, rewritten per tick).
 * ``heartbeat`` — per-process ``heartbeat-p<idx>.json`` liveness files
-  plus ``check_heartbeats()`` so a multi-host run can detect a dead
-  peer instead of hanging forever in a collective.
+  (now carrying per-device HBM stats via ``sample_hbm``) plus
+  ``check_heartbeats()`` — staleness AND inter-process step skew — so a
+  multi-host run can detect a dead or straggling peer instead of
+  hanging forever in a collective.
+* ``device_time`` — the periodic device-truth sampler (ISSUE 8): flag-
+  gated ``jax.profiler`` windows parsed into ``device/*`` gauges
+  (device-time MFU, per-program device ms, wall-vs-device divergence).
+  The one layer that DOES import jax — lazily, inside methods.
 
-The train loop wires all three (train/loop.py); the data pipeline,
+The train loop wires all of them (train/loop.py); the data pipeline,
 checkpointing, and metric layers record into the registry directly.
-``docs/observability.md`` describes the run-dir artifacts.
+``docs/observability.md`` describes the run-dir artifacts;
+``gansformer-telemetry doctor <run_dir>`` cross-checks them in one
+report.
 """
 
+from gansformer_tpu.obs.device_time import DeviceTimeSampler  # noqa: F401
 from gansformer_tpu.obs.heartbeat import (  # noqa: F401
-    Heartbeat, check_heartbeats, device_memory_stats, read_heartbeats)
+    Heartbeat, check_heartbeats, device_memory_stats, read_heartbeats,
+    sample_hbm)
 from gansformer_tpu.obs.registry import (  # noqa: F401
     Registry, counter, gauge, get_registry, histogram)
 from gansformer_tpu.obs.spans import (  # noqa: F401
@@ -29,17 +39,23 @@ _COMPILE_LISTENER = {"installed": False}
 
 
 def install_compile_listener() -> bool:
-    """Count XLA compiles into ``xla/compile_count`` (+ a duration
-    histogram ``xla/compile_ms``) via jax.monitoring.  Idempotent;
-    returns False (and stays silent) when jax or its monitoring events
-    are unavailable — telemetry must never be a dependency.
+    """Count XLA compiles into ``compile/compiles_total`` (+ a duration
+    histogram ``compile/compile_ms``) via jax.monitoring.  The listener
+    registers once per process, but the instruments are re-materialized
+    on every call — the loop calls this after its per-run
+    ``Registry.reset()``, so even a fully-warm-cache run exports an
+    explicit ``compile_compiles_total 0.0``.  Returns False (and stays
+    silent) when jax or its monitoring events are unavailable —
+    telemetry must never be a dependency.
     """
-    if _COMPILE_LISTENER["installed"]:
-        return True
     try:
         from jax import monitoring
     except Exception:
         return False
+    counter("compile/compiles_total")
+    histogram("compile/compile_ms")
+    if _COMPILE_LISTENER["installed"]:
+        return True
 
     def _on_duration(event: str, duration: float, **kw) -> None:
         # one event per actual XLA compile — NOT the per-call jaxpr-trace
@@ -47,8 +63,8 @@ def install_compile_listener() -> bool:
         # resolved per event (cheap dict lookup) so a per-run
         # Registry.reset() can't orphan them.
         if "backend_compile" in event:
-            counter("xla/compile_count").inc()
-            histogram("xla/compile_ms").observe(duration * 1000.0)
+            counter("compile/compiles_total").inc()
+            histogram("compile/compile_ms").observe(duration * 1000.0)
 
     try:
         monitoring.register_event_duration_secs_listener(_on_duration)
@@ -80,7 +96,7 @@ class RetraceWatch:
         """Freeze the warm-up compile count; later compiles are
         retraces.  Also materializes the counter so telemetry shows an
         explicit 0 from the first armed tick."""
-        self._baseline = counter("xla/compile_count").value
+        self._baseline = counter("compile/compiles_total").value
         counter("compile/retraces_total")
 
     def poll(self) -> float:
@@ -88,7 +104,7 @@ class RetraceWatch:
         running total.  Cheap — two registry lookups; call per tick."""
         if self._baseline is None:
             return 0.0
-        seen = counter("xla/compile_count").value - self._baseline
+        seen = counter("compile/compiles_total").value - self._baseline
         c = counter("compile/retraces_total")
         if seen > c.value:
             c.inc(seen - c.value)

@@ -1,0 +1,179 @@
+"""Periodic device-time sampler — the loop's "the device said so" layer
+(ISSUE 8 tentpole a).
+
+Wall-clock spans (obs/spans.py) say where the HOST loop's time went; the
+r3 retraction proved they can lie about what the chip is doing.  This
+sampler generalizes the loop's one-shot steady-state profiler window
+(``TrainConfig.profile_dir``) into a flag-gated periodic probe: every
+``every_ticks`` ticks it wraps ONE full tick window (boundary to
+boundary — both endpoints are ``block_until_ready``-synced, so the wall
+comparison is honest) in a ``jax.profiler`` trace to a temp dir, parses
+it with ``utils/profparse.py`` (xplane, or the no-TensorFlow Chrome
+fallback), folds the result into the telemetry registry, and deletes
+the trace.  Gauges:
+
+* ``device/busy_ms`` / ``device/span_ms`` / ``device/wall_ms`` — the
+  sampled window's merged device-busy time, trace span, and host wall.
+* ``device/wall_busy_ratio`` — busy/wall, THE wall-vs-device divergence
+  gauge: ≈1 compute-bound, ≪1 host-bound, >1 means the wall clock is
+  not covering device execution (the r3 failure mode).
+* ``device/phase_ms/<program>`` — per-jitted-program attribution
+  (``d_step``, ``g_step_pl``, ``cycle``, …; names come from the trace's
+  ``PjitFunction``/``jit_*`` events).
+* ``device/mfu`` — device-time MFU (FLOPs actually executed over busy
+  seconds vs chip peak), beside the wall-clock ``timing/mfu`` stat.
+* ``device/samples_total`` / ``device/sample_failed_total`` counters,
+  ``device/unavailable`` (no parser could read the last trace) and
+  ``device/sampler_off`` (the explicit profiling-is-off marker the
+  telemetry schema lint requires) gauges.
+
+Every profiler call is wrapped: a wedged or unavailable tracer costs
+one failed sample, never training.  CAUTION for unattended tunnel runs:
+a client killed mid-trace was observed (bench.py r4 note) to wedge the
+relayed backend claim for subsequent processes — the battery's train
+stage passes ``--device-time-ticks 0`` for exactly that reason.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+import time
+from typing import Optional
+
+from gansformer_tpu.obs.registry import counter, gauge
+
+# bound the device/phase_ms/* cardinality: keep the heaviest programs
+_MAX_PROGRAMS = 12
+
+
+class DeviceTimeSampler:
+    def __init__(self, every_ticks: int = 0,
+                 flops_per_it: Optional[float] = None,
+                 peak_tflops: Optional[float] = None,
+                 enabled: bool = True):
+        self.every = int(every_ticks or 0)
+        self.enabled = bool(enabled) and self.every > 0
+        self.flops_per_it = flops_per_it
+        self.peak_tflops = peak_tflops
+        self._dir: Optional[str] = None
+        self._t0 = 0.0
+        # materialize the markers at construction (the loop builds the
+        # sampler after the per-run registry reset) so the FIRST prom
+        # write already answers "is device truth being sampled?"
+        gauge("device/sampler_off").set(0.0 if self.enabled else 1.0)
+        if self.enabled:
+            counter("device/samples_total")
+            self._warm()
+
+    def _warm(self) -> None:
+        """Pay the profiler's one-time per-process init (measured ~11 s
+        on this container) HERE, at setup — outside any tick window —
+        with a throwaway start/stop.  Without this the first sampled
+        tick carries ~11 s of uncovered wall, which both breaks the
+        phase-sum invariant (sum(timing/phase/*) ≈ sec_per_tick) and
+        skews the first divergence ratio low.  Subsequent starts are
+        ~0 s (verified); a failure here just means the first real
+        sample pays the init instead."""
+        import jax
+
+        tdir = tempfile.mkdtemp(prefix="graft_devtime_warm_")
+        try:
+            jax.profiler.start_trace(tdir)
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
+
+    @property
+    def sampling(self) -> bool:
+        return self._dir is not None
+
+    def maybe_start(self, tick: int) -> bool:
+        """Start a trace at this tick boundary when the cadence says so
+        (``tick % every == 1`` — the same "first steady-state window"
+        alignment as the one-shot ``profile_dir`` trace; ``every == 1``
+        fires at every boundary, hence the ``1 % every`` right-hand
+        side).  The trace is stopped and folded by ``stop_and_fold`` at
+        the NEXT boundary."""
+        if not self.enabled or self.sampling \
+                or tick % self.every != 1 % self.every:
+            return False
+        import jax
+
+        tdir = tempfile.mkdtemp(prefix="graft_devtime_")
+        try:
+            jax.profiler.start_trace(tdir)
+        except Exception:
+            # tracer unavailable/already active: one failed sample,
+            # never a dead run
+            shutil.rmtree(tdir, ignore_errors=True)
+            counter("device/sample_failed_total").inc()
+            return False
+        self._dir = tdir
+        self._t0 = time.time()
+        return True
+
+    def stop_and_fold(self, wall_s: Optional[float] = None,
+                      iters: Optional[float] = None) -> Optional[dict]:
+        """Stop the active trace, parse it, fold the registry gauges,
+        delete the trace dir.  ``wall_s`` is the sampled window's host
+        wall (the caller's ``sec_per_tick`` — both endpoints synced);
+        ``iters`` the training iterations the window ran (for device-time
+        MFU).  Returns the ``device_time_report`` dict (with ``wall_s``
+        added) or None when no trace was active / the stop failed."""
+        if not self.sampling:
+            return None
+        import jax
+
+        tdir, self._dir = self._dir, None
+        wall = wall_s if wall_s is not None else time.time() - self._t0
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            shutil.rmtree(tdir, ignore_errors=True)
+            counter("device/sample_failed_total").inc()
+            return None
+        from gansformer_tpu.utils.profparse import device_time_report
+
+        try:
+            rep = device_time_report(tdir)
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
+        rep["wall_s"] = wall
+        if rep.get("status") != "ok":
+            counter("device/sample_failed_total").inc()
+            gauge("device/unavailable").set(1.0)
+            return rep
+        counter("device/samples_total").inc()
+        gauge("device/unavailable").set(0.0)
+        busy = rep["busy_s"]
+        gauge("device/busy_ms").set(busy * 1e3)
+        gauge("device/span_ms").set(rep["span_s"] * 1e3)
+        gauge("device/wall_ms").set(wall * 1e3)
+        if wall > 0:
+            gauge("device/wall_busy_ratio").set(busy / wall)
+        progs = sorted(rep.get("program_busy_s", {}).items(),
+                       key=lambda kv: -kv[1])[:_MAX_PROGRAMS]
+        for name, s in progs:
+            gauge(f"device/phase_ms/{name}").set(s * 1e3)
+        if self.flops_per_it and self.peak_tflops and iters and busy > 0:
+            rep["device_mfu"] = (self.flops_per_it * iters / busy
+                                 / (self.peak_tflops * 1e12))
+            gauge("device/mfu").set(rep["device_mfu"])
+        return rep
+
+    def close(self) -> None:
+        """Discard an in-flight trace without folding (exception paths,
+        end of run) so the process-global profiler is released."""
+        if not self.sampling:
+            return
+        import jax
+
+        tdir, self._dir = self._dir, None
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        shutil.rmtree(tdir, ignore_errors=True)

@@ -53,6 +53,57 @@ def device_memory_stats() -> Optional[dict]:
     return out
 
 
+def hbm_device_stats() -> Optional[dict]:
+    """Max-over-LOCAL-devices HBM stats right now, or None when the
+    backend reports no memory stats (CPU) or jax is unimportable.  Max,
+    not sum: a straggler device OOMs first, so the per-device view is
+    the one that answers "does FFHQ-1024 fit".  Pure read (no gauges) —
+    shared by ``sample_hbm`` and bench.py's artifact snapshot so the
+    two can never disagree on aggregation."""
+    try:
+        import jax
+
+        per_dev = [d.memory_stats() or {} for d in jax.local_devices()]
+    except Exception:
+        return None
+    per_dev = [s for s in per_dev if s]
+    if not per_dev:
+        return None
+    return {
+        "bytes_in_use": max(s.get("bytes_in_use", 0) for s in per_dev),
+        "peak_bytes": max(s.get("peak_bytes_in_use", 0) for s in per_dev),
+        "bytes_limit": max(s.get("bytes_limit", 0) for s in per_dev),
+        "devices": len(per_dev),
+    }
+
+
+def sample_hbm() -> Optional[dict]:
+    """Per-tick HBM gauges (ISSUE 8 tentpole b) from
+    ``hbm_device_stats``:
+
+    * ``hbm/bytes_in_use`` (gauge, current), ``hbm/peak_bytes``
+      (high-water gauge), ``hbm/bytes_limit`` (when the backend reports
+      it), ``hbm/devices`` (local devices that reported).
+    * ``hbm/unavailable`` — 1.0 when the backend reports no memory
+      stats (CPU) or jax is unimportable; the EXPLICIT marker the
+      telemetry schema lint requires, so "no hbm numbers" can never be
+      confused with "forgot to sample".
+
+    Returns the sampled dict (embedded in the heartbeat record) or None
+    when unavailable."""
+    out = hbm_device_stats()
+    if out is None:
+        gauge("hbm/unavailable").set(1.0)
+        return None
+    gauge("hbm/unavailable").set(0.0)
+    gauge("hbm/bytes_in_use").set(out["bytes_in_use"])
+    gauge("hbm/peak_bytes").max(out["peak_bytes"])
+    gauge("hbm/devices").set(out["devices"])
+    if out["bytes_limit"]:
+        gauge("hbm/bytes_limit").set(out["bytes_limit"])
+    return out
+
+
 def host_rss_peak_bytes() -> Optional[int]:
     """Peak resident set of this process (linux ru_maxrss is KiB)."""
     try:
@@ -93,6 +144,9 @@ class Heartbeat:
         mem = device_memory_stats()
         if mem is not None:
             rec["device_memory"] = mem
+        hbm = sample_hbm()
+        if hbm is not None:
+            rec["hbm"] = hbm
         rss = host_rss_peak_bytes()
         if rss is not None:
             rec["host_rss_peak_bytes"] = rss
@@ -119,15 +173,21 @@ def read_heartbeats(run_dir: str) -> Dict[int, dict]:
 
 def check_heartbeats(run_dir: str, max_age_s: float = 300.0,
                      expected: Optional[List[int]] = None,
-                     now: Optional[float] = None) -> dict:
-    """Staleness probe over a run dir's heartbeat files.
+                     now: Optional[float] = None,
+                     max_step_skew: Optional[int] = None) -> dict:
+    """Staleness + straggler probe over a run dir's heartbeat files.
 
-    Returns ``{"ok", "ages", "stale", "missing"}`` where ``ages`` maps
-    process index → seconds since its last beat, ``stale`` lists
-    processes older than ``max_age_s``, and ``missing`` lists expected
-    indices with no file at all.  ``ok`` is True iff neither list is
-    non-empty.  ``expected=None`` checks only the processes that have
-    ever written (missing detection needs the roster).
+    Returns ``{"ok", "ages", "stale", "missing", "steps", "step_skew",
+    "skew_exceeded"}`` where ``ages`` maps process index → seconds since
+    its last beat, ``stale`` lists processes older than ``max_age_s``,
+    ``missing`` lists expected indices with no file at all, and
+    ``step_skew`` is the max inter-process step spread (``max(step) -
+    min(step)`` — the straggler signal for a multihost run whose peers
+    all still beat but one lags the collectives; ISSUE 8 satellite).
+    ``skew_exceeded`` is True when ``max_step_skew`` is given and the
+    spread is larger; ``ok`` is True iff nothing is stale, missing, or
+    skew-exceeded.  ``expected=None`` checks only the processes that
+    have ever written (missing detection needs the roster).
     """
     now = time.time() if now is None else now
     beats = read_heartbeats(run_dir)
@@ -135,5 +195,11 @@ def check_heartbeats(run_dir: str, max_age_s: float = 300.0,
     stale = sorted(idx for idx, age in ages.items() if age > max_age_s)
     missing = (sorted(set(expected) - set(beats))
                if expected is not None else [])
-    return {"ok": not stale and not missing, "ages": ages,
-            "stale": stale, "missing": missing}
+    steps = {idx: int(rec.get("step", 0)) for idx, rec in beats.items()}
+    step_skew = (max(steps.values()) - min(steps.values())) if steps else 0
+    skew_exceeded = (max_step_skew is not None
+                     and step_skew > max_step_skew)
+    return {"ok": not stale and not missing and not skew_exceeded,
+            "ages": ages, "stale": stale, "missing": missing,
+            "steps": steps, "step_skew": step_skew,
+            "skew_exceeded": skew_exceeded}

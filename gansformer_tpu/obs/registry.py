@@ -50,6 +50,27 @@ def prom_name(name: str) -> str:
     return n
 
 
+def parse_prom_values(path: str) -> Dict[str, float]:
+    """``telemetry.prom`` sample lines → {prom name: value} (last write
+    wins).  Lives next to ``export_text`` so the ONE module that owns
+    the format both writes and reads it — the doctor and the schema
+    lint's family check are the consumers."""
+    out: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                continue
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                continue
+    return out
+
+
 class Counter:
     """Monotonic count.  ``inc()`` only — decrements are a gauge's job."""
 

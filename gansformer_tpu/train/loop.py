@@ -150,7 +150,7 @@ def _train(cfg: ExperimentConfig, run_dir: str,
         if jax.process_index() == 0 else None,
         process_index=jax.process_index(),
         truncate=not resume)
-    obs.install_compile_listener()     # xla/compile_count + xla/compile_ms
+    obs.install_compile_listener()  # compile/compiles_total + compile_ms
     # Post-warm-up compiles are retraces (compile/retraces_total) — the
     # runtime cross-check of the static retrace-hazard trace rule: armed
     # at the first tick boundary (all step variants compiled by then),
@@ -210,6 +210,12 @@ def _train(cfg: ExperimentConfig, run_dir: str,
         if last is not None:
             state = ckpt.restore(ckpt_dir, state)
             log.write(f"resumed from step {last} ({last / 1000:.1f} kimg)")
+            if jax.process_index() == 0:
+                # One line per restart (resumes.jsonl): the run doctor's
+                # restart-count / availability evidence (ROADMAP item 5).
+                from gansformer_tpu.utils.logging import append_resume_record
+
+                append_resume_record(run_dir, step=last)
 
     # State placement: params/EMA/stats replicated across the mesh;
     # under --fsdp the optimizer moments shard per-leaf over the data
@@ -302,6 +308,19 @@ def _train(cfg: ExperimentConfig, run_dir: str,
             log.write(f"mfu bookkeeping unavailable: "
                       f"{type(e).__name__}: {str(e)[:200]}")
             flops_per_it = None
+
+    # --- device-truth sampler (ISSUE 8) --------------------------------------
+    # Periodic jax.profiler windows — one full tick traced every
+    # device_time_ticks ticks, parsed (utils/profparse.py: xplane or the
+    # Chrome-trace fallback) and folded into device/* gauges: per-program
+    # device ms, device-time MFU beside the wall-clock timing/mfu, and
+    # the wall-vs-device divergence ratio that would have caught the
+    # retracted r3 number.  Process 0 only (it owns telemetry.prom); the
+    # one-shot profile_dir trace owns the profiler when set.
+    sampler = obs.DeviceTimeSampler(
+        every_ticks=t.device_time_ticks,
+        flops_per_it=flops_per_it, peak_tflops=peak,
+        enabled=jax.process_index() == 0 and not t.profile_dir)
 
     # --- fixed grid latents for snapshots ------------------------------------
     grid_n = min(16, t.batch_size * 2)
@@ -566,6 +585,24 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                     sec_per_it = sec_per_tick / (imgs_done / t.batch_size)
                     stats["timing/mfu"] = (
                         flops_per_it / sec_per_it / (peak * 1e12))
+                if sampler.sampling:
+                    # The sampled window ends HERE (both endpoints are
+                    # block_until_ready-synced, so busy-vs-wall is
+                    # honest).  Folds device/* gauges before the
+                    # registry snapshot below captures them.
+                    dev = sampler.stop_and_fold(
+                        wall_s=sec_per_tick,
+                        iters=imgs_done / t.batch_size)
+                    if dev is not None and dev.get("status") == "ok":
+                        log.write(
+                            "device sample: busy {:.0f} ms / wall "
+                            "{:.0f} ms (ratio {:.2f}, {})".format(
+                                dev["busy_s"] * 1e3, sec_per_tick * 1e3,
+                                dev["busy_s"] / max(sec_per_tick, 1e-9),
+                                dev["source"]))
+                    elif dev is not None:
+                        log.write("device sample unavailable: "
+                                  f"{dev.get('reason', '?')[:200]}")
                 if tick == 0:
                     retrace_watch.arm()    # warm-up compiles end here
                 else:
@@ -595,6 +632,12 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                     profiling = False
                     log.write("profiler: trace complete (window: the tick "
                               "whose stats line above says Progress/tick=1)")
+                elif cur_nimg < total_kimg * 1000:
+                    # periodic device-truth sample: trace the WHOLE next
+                    # tick window; stopped & folded at the next boundary
+                    # (no-op unless the cadence fires — and never while
+                    # the one-shot profile_dir trace owns the profiler)
+                    sampler.maybe_start(tick)
 
                 if t.image_snapshot_ticks and tick % t.image_snapshot_ticks == 0:
                     snapshot_images(state, cur_nimg / 1000)
@@ -635,6 +678,9 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     finally:
         if profiling:
             jax.profiler.stop_trace()
+        # discard (not fold) any in-flight device-time sample: the
+        # process-global profiler must be released on every exit path
+        sampler.close()
         # Close order matters: the host-side PrefetchIterator first (its
         # close() parks a sentinel that wakes a transfer thread blocked on
         # an empty host queue), then the DevicePrefetcher join.

@@ -430,11 +430,25 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
     cycle_fn = _wrap_cycle(jax.jit(_cycle, **donate_state), _cycle) \
         if can_cycle else None
 
+    def _named(name, fn, **kw):
+        # jax.jit labels the PjitFunction trace events and the HloModule
+        # after __name__; an anonymous partial traces as "<unnamed
+        # function>", which would collapse all four phase variants into
+        # one bucket in the device-time sampler's device/phase_ms/*
+        # attribution (obs/device_time.py).
+        p = functools.partial(fn, **kw)
+        p.__name__ = name
+        return p
+
     fns = TrainStepFns(
-        d_step=jax.jit(functools.partial(_d_step, do_r1=False), **donate_state),
-        d_step_r1=jax.jit(functools.partial(_d_step, do_r1=True), **donate_state),
-        g_step=jax.jit(functools.partial(_g_step, do_pl=False), **donate_state),
-        g_step_pl=jax.jit(functools.partial(_g_step, do_pl=True), **donate_state),
+        d_step=jax.jit(_named("d_step", _d_step, do_r1=False),
+                       **donate_state),
+        d_step_r1=jax.jit(_named("d_step_r1", _d_step, do_r1=True),
+                          **donate_state),
+        g_step=jax.jit(_named("g_step", _g_step, do_pl=False),
+                       **donate_state),
+        g_step_pl=jax.jit(_named("g_step_pl", _g_step, do_pl=True),
+                          **donate_state),
         cycle=cycle_fn,
         cycle_len=d_reg if can_cycle else 0,
         cycle_counts=cycle_counts,

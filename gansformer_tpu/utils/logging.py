@@ -38,6 +38,34 @@ def write_flag(run_dir: str, name: str, value) -> None:
         f.write(f"{name} {v}\n")
 
 
+def append_resume_record(run_dir: str, step: int) -> None:
+    """One JSON line per ``--resume`` restart → ``resumes.jsonl``.  The
+    run doctor counts these as the restart/availability evidence (ISSUE
+    8 / ROADMAP item 5): a run dir with N lines survived N preemptions
+    or crashes, and the last line says where it picked back up."""
+    rec = {"time": time.time(), "step": int(step), "pid": os.getpid()}
+    with open(os.path.join(run_dir, "resumes.jsonl"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def read_resume_records(run_dir: str):
+    """Resume records, torn-line-tolerant (a SIGKILL mid-append is the
+    normal ending for exactly the runs the doctor inspects)."""
+    path = os.path.join(run_dir, "resumes.jsonl")
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
 class RunLogger:
     """Run-dir writer.  ``active=False`` (non-zero process index in a
     multi-host run) turns every write into a no-op so only one host owns

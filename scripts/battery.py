@@ -126,11 +126,25 @@ def default_stages():
         stage("pallas", 600, "pallas_tpu.json",
               [py, "scripts/bench_pallas_attention.py"]),
         # 9. Real loop on the chip; stats.jsonl carries timing/mfu.
+        #    --device-time-ticks 0: the periodic device-truth sampler is
+        #    OFF for this unattended stage — a client killed mid-trace
+        #    was observed (r4) to wedge the tunnel's backend claim for
+        #    20+ minutes, and a wedged claim here would re-burn this
+        #    stage's budget every window forever.  Device truth for the
+        #    battery comes from the witness/doctor instead.  After the
+        #    run, the doctor's JSON report (ISSUE 8) is archived into
+        #    the window ledger; capture beats verdict (same rationale as
+        #    graftcomms) — the stage completes on the TRAIN exit code.
         stage("train_ticks", 1200, None,
-              [py, "-m", "gansformer_tpu.cli.train",
-               "--preset", "ffhq256-duplex", "--data-source", "synthetic",
-               "--batch-size", "8", "--total-kimg", "8", "--fused-cycle",
-               "--results-dir", "{win}/train_tpu"]),
+              ["sh", "-c",
+               f"{py} -m gansformer_tpu.cli.train"
+               f" --preset ffhq256-duplex --data-source synthetic"
+               f" --batch-size 8 --total-kimg 8 --fused-cycle"
+               f" --device-time-ticks 0"
+               f" --results-dir {{win}}/train_tpu; rc=$?;"
+               f" {py} -m gansformer_tpu.cli.telemetry doctor"
+               f" {{win}}/train_tpu --json-out {{win}}/doctor.json;"
+               f" exit $rc"]),
     ]
 
 

@@ -29,6 +29,7 @@ from gansformer_tpu.analysis.telemetry_schema import (  # noqa: E402,F401
     PROM_TYPES,
     check_events,
     check_heartbeat,
+    check_metric_families,
     check_prom,
     check_run_dir,
     main,

@@ -45,10 +45,15 @@ def micro_overlap_cfg(total_kimg=3):
     from tests.test_train import micro_cfg
 
     cfg = micro_cfg(attention="simplex", batch=8)
+    # device_time_ticks=2: micro_cfg turns the device-truth sampler OFF
+    # (suite cost); THIS shared run re-enables it so the ISSUE 8
+    # acceptance tests see a landed sample (tick 1 traced) in
+    # telemetry.prom without any other test paying for the profiler.
     return dataclasses.replace(
         cfg, train=dataclasses.replace(
             cfg.train, total_kimg=total_kimg, kimg_per_tick=1,
-            snapshot_ticks=1, image_snapshot_ticks=1))
+            snapshot_ticks=1, image_snapshot_ticks=1,
+            device_time_ticks=2))
 
 
 @pytest.fixture(scope="session")

@@ -226,11 +226,26 @@ def test_lint_run_dir_findings_and_cli(tmp_path, capsys):
     (tmp_path / "events.jsonl").write_text(
         '{"name": "step", "ph": "X", "ts": 1, "dur": 2, '
         '"pid": 0, "tid": 0}\n')
+    # missing the ISSUE 8 families (device/hbm/compile markers) is itself
+    # a finding — "no device numbers" must be explicit, never silent
     (tmp_path / "telemetry.prom").write_text(
         "# TYPE data_wait_ms summary\ndata_wait_ms_count 3.0\n")
     (tmp_path / "heartbeat-p0.json").write_text(json.dumps(
         {"process": 0, "pid": 1, "host": "h", "time": 1.0,
          "step": 0, "kimg": 0.0}))
+    findings = lint_run_dir(str(tmp_path))
+    assert findings
+    msgs = " ".join(f.message for f in findings)
+    assert "device_sampler_off" in msgs and "hbm_unavailable" in msgs \
+        and "compile_compiles_total" in msgs
+    (tmp_path / "telemetry.prom").write_text(
+        "# TYPE data_wait_ms summary\ndata_wait_ms_count 3.0\n"
+        "# TYPE device_sampler_off gauge\ndevice_sampler_off 1.0\n"
+        "# TYPE hbm_unavailable gauge\nhbm_unavailable 1.0\n"
+        "# TYPE compile_compiles_total counter\n"
+        "compile_compiles_total 0.0\n"
+        "# TYPE compile_retraces_total counter\n"
+        "compile_retraces_total 0.0\n")
     assert lint_run_dir(str(tmp_path)) == []
 
     rc = cli_main(["--run-dir", str(tmp_path)])
@@ -242,3 +257,29 @@ def test_lint_run_dir_findings_and_cli(tmp_path, capsys):
     findings = lint_run_dir(str(tmp_path))
     assert any(f.line == 1 and f.path.endswith("events.jsonl")
                for f in findings)
+
+
+def test_check_metric_families_value_aware(tmp_path):
+    """The family check reads VALUES, not just names: a sampler that
+    claims to be on with landed samples must also export the divergence
+    gauges; a reporting backend must export the hbm numbers."""
+    from gansformer_tpu.analysis.telemetry_schema import (
+        check_metric_families)
+
+    p = tmp_path / "telemetry.prom"
+    base = ("hbm_unavailable 0.0\nhbm_bytes_in_use 1.0\n"
+            "hbm_peak_bytes 2.0\ncompile_compiles_total 1.0\n"
+            "compile_retraces_total 0.0\n")
+    p.write_text("device_sampler_off 0.0\ndevice_samples_total 2.0\n"
+                 + base)
+    assert any("divergence" in e for e in check_metric_families(str(p)))
+    p.write_text("device_sampler_off 0.0\ndevice_samples_total 2.0\n"
+                 "device_wall_busy_ratio 0.9\ndevice_busy_ms 900.0\n"
+                 + base)
+    assert check_metric_families(str(p)) == []
+    # backend claims memory reporting but exports no numbers
+    p.write_text("device_sampler_off 1.0\nhbm_unavailable 0.0\n"
+                 "compile_compiles_total 1.0\n"
+                 "compile_retraces_total 0.0\n")
+    assert any("hbm_bytes_in_use" in e
+               for e in check_metric_families(str(p)))

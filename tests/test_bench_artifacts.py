@@ -77,6 +77,26 @@ def test_phase_artifact_partial_label_and_reg_approximation():
     assert out["value"] > full["value"]
 
 
+def test_phase_artifact_device_ms_beside_wall():
+    """ISSUE 8 satellite: when a profiler capture supplied per-phase
+    device time, the artifact carries it next to the wall phase_ms plus
+    a device-time MFU per covered phase — and the device numbers don't
+    perturb the wall-derived throughput/suspect logic."""
+    dev = {"d": TIMES["d"] * 1e3 * 0.98}     # device ≈ wall (honest run)
+    out = bench.build_phase_artifact(**phase_kwargs(device_ms=dev))
+    assert out["phase_device_ms"] == {"d": pytest.approx(dev["d"],
+                                                         rel=1e-3)}
+    # device-time MFU from the same FLOPs over DEVICE ms
+    assert out["phase_device_mfu"]["d"] == pytest.approx(
+        FLOPS["d"] / (dev["d"] / 1e3) / (PEAK * 1e12), abs=0.005)
+    assert "suspect" not in out
+    base = bench.build_phase_artifact(**phase_kwargs())
+    assert out["value"] == base["value"]
+    # no capture → the keys are absent, not empty
+    assert "phase_device_ms" not in base
+    assert "phase_device_mfu" not in base
+
+
 def test_phase_artifact_cpu_proxy_has_null_ratio():
     out = bench.build_phase_artifact(**phase_kwargs(
         on_tpu=False, peak=None, metric="train_img_per_sec_per_chip_cpu_proxy"))

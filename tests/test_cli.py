@@ -39,6 +39,11 @@ def test_cli_overrides():
     assert cfg.data.source == "synthetic"
     # untouched fields keep preset values
     assert cfg.model.resolution == 256
+    # device-truth sampling (ISSUE 8): default cadence inherited, 0 = off
+    assert cfg.train.device_time_ticks == 8
+    args = build_parser().parse_args([
+        "--preset", "ffhq256-duplex", "--device-time-ticks", "0"])
+    assert config_from_args(args).train.device_time_ticks == 0
 
 
 def test_cli_defaults_valid():

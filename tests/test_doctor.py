@@ -1,0 +1,225 @@
+"""``gansformer-telemetry doctor`` — PASS/WARN goldens over synthetic
+run dirs (ISSUE 8 tentpole c), the JSON output mode, exit codes, and
+the results-root descent the battery relies on."""
+
+import json
+import os
+
+import pytest
+
+from gansformer_tpu.cli.telemetry import (
+    main as cli_main, render_doctor, resolve_run_dir, run_doctor)
+
+NOW = 1_000_000.0
+
+
+def synth_run_dir(tmp_path, *, gauges=None, counters=None, stats=None,
+                  beats=None, resumes=0, name="run"):
+    """A minimal healthy run dir; keyword overrides poison individual
+    signals for the WARN/FAIL goldens."""
+    d = tmp_path / name
+    d.mkdir()
+    g = {"device/sampler_off": 0.0, "device/unavailable": 0.0,
+         "device/busy_ms": 900.0, "device/span_ms": 950.0,
+         "device/wall_ms": 1000.0, "device/wall_busy_ratio": 0.9,
+         "device/mfu": 0.33, "device/phase_ms/d_step": 400.0,
+         "device/phase_ms/g_step": 300.0,
+         "hbm/unavailable": 0.0, "hbm/bytes_in_use": 2e9,
+         "hbm/peak_bytes": 4e9, "hbm/bytes_limit": 16e9,
+         "data/prefetch_queue_depth": 2.0,
+         "data/device_queue_depth": 2.0}
+    g.update(gauges or {})
+    c = {"device/samples_total": 2.0, "compile/compiles_total": 12.0,
+         "compile/retraces_total": 0.0, "data/starved_total": 0.0}
+    c.update(counters or {})
+    rec = {"Progress/tick": 3, "Progress/kimg": 4.0,
+           "timing/sec_per_tick": 10.0, "timing/img_per_sec": 100.0,
+           "timing/img_per_sec_per_chip": 100.0,
+           "timing/data_wait_s": 0.5, "timing/data_wait_frac": 0.05,
+           "timing/mfu": 0.30,
+           "telemetry": {"counters": c, "gauges": g, "histograms": {}}}
+    rec.update(stats or {})
+    with open(d / "stats.jsonl", "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    # prom mirrors a subset (the doctor prefers stats.jsonl; prom presence
+    # satisfies the artifacts check)
+    with open(d / "telemetry.prom", "w") as f:
+        f.write("# TYPE device_sampler_off gauge\n"
+                f"device_sampler_off {g['device/sampler_off']}\n")
+    for idx, rec_hb in (beats if beats is not None else
+                        {0: {"time": NOW - 5.0, "step": 4000}}).items():
+        hb = {"process": idx, "pid": 1, "host": "h", "kimg": 4.0}
+        hb.update(rec_hb)
+        with open(d / f"heartbeat-p{idx}.json", "w") as f:
+            f.write(json.dumps(hb))
+    for i in range(resumes):
+        with open(d / "resumes.jsonl", "a") as f:
+            f.write(json.dumps({"time": NOW - 100 + i, "step": 1000 * i,
+                                "pid": 1}) + "\n")
+    return str(d)
+
+
+def levels(report):
+    return {c["name"]: c["level"] for c in report["checks"]}
+
+
+def detail(report, name):
+    return next(c["detail"] for c in report["checks"] if c["name"] == name)
+
+
+def test_healthy_run_all_pass(tmp_path):
+    d = synth_run_dir(tmp_path)
+    report = run_doctor(d, now=NOW)
+    assert report["ok"] and report["n_fail"] == 0
+    lv = levels(report)
+    for name in ("artifacts", "progress", "device_truth", "mfu",
+                 "data_wait", "queues", "compiles", "hbm", "heartbeats",
+                 "restarts", "device_phases"):
+        assert lv[name] == "PASS", (name, lv)
+    assert report["n_warn"] == 0
+    # device phase table is ranked heaviest-first
+    assert detail(report, "device_phases").index("d_step") < \
+        detail(report, "device_phases").index("g_step")
+    text = render_doctor(report)
+    assert "verdict: OK" in text and "PASS device_truth" in text
+
+
+def test_sampler_off_and_wall_divergence_warn(tmp_path):
+    off = run_doctor(synth_run_dir(
+        tmp_path, gauges={"device/sampler_off": 1.0}, name="off"), now=NOW)
+    assert levels(off)["device_truth"] == "WARN"
+    assert "sampler OFF" in detail(off, "device_truth")
+    assert off["ok"]                       # WARN never fails the doctor
+
+    lying = run_doctor(synth_run_dir(
+        tmp_path, gauges={"device/wall_busy_ratio": 1.4}, name="lying"),
+        now=NOW)
+    assert levels(lying)["device_truth"] == "WARN"
+    assert "NOT covering device execution" in detail(lying, "device_truth")
+
+    idle = run_doctor(synth_run_dir(
+        tmp_path, gauges={"device/wall_busy_ratio": 0.1}, name="idle"),
+        now=NOW)
+    assert "host-bound" in detail(idle, "device_truth")
+
+
+def test_mfu_divergence_warns_toward_device_number(tmp_path):
+    d = synth_run_dir(tmp_path, gauges={"device/mfu": 0.20},
+                      stats={"timing/mfu": 0.35})
+    report = run_doctor(d, now=NOW)
+    assert levels(report)["mfu"] == "WARN"
+    assert "trust the device number" in detail(report, "mfu")
+    # agreement passes
+    ok = run_doctor(synth_run_dir(tmp_path, gauges={"device/mfu": 0.31},
+                                  name="ok"), now=NOW)
+    assert levels(ok)["mfu"] == "PASS"
+
+
+def test_input_pipeline_warnings(tmp_path):
+    d = synth_run_dir(tmp_path, stats={"timing/data_wait_frac": 0.6},
+                      counters={"data/starved_total": 7.0})
+    report = run_doctor(d, now=NOW)
+    assert levels(report)["data_wait"] == "WARN"
+    assert "input-bound" in detail(report, "data_wait")
+    assert levels(report)["queues"] == "WARN"
+    assert "starved_total = 7" in detail(report, "queues")
+
+
+def test_retraces_and_hbm_warnings(tmp_path):
+    d = synth_run_dir(tmp_path,
+                      counters={"compile/retraces_total": 3.0},
+                      gauges={"hbm/peak_bytes": 15.5e9})
+    report = run_doctor(d, now=NOW)
+    assert levels(report)["compiles"] == "WARN"
+    assert "3 post-warm-up compile(s)" in detail(report, "compiles")
+    assert levels(report)["hbm"] == "WARN"
+    assert "from OOM" in detail(report, "hbm")
+    # CPU backends report no memory stats: PASS, not WARN
+    cpu = run_doctor(synth_run_dir(
+        tmp_path, gauges={"hbm/unavailable": 1.0}, name="cpu"), now=NOW)
+    assert levels(cpu)["hbm"] == "PASS"
+
+
+def test_heartbeat_staleness_fails_only_with_max_age(tmp_path):
+    beats = {0: {"time": NOW - 5.0, "step": 4000},
+             1: {"time": NOW - 500.0, "step": 4000}}
+    d = synth_run_dir(tmp_path, beats=beats)
+    dflt = run_doctor(d, now=NOW)
+    assert levels(dflt)["heartbeats"] == "PASS"      # archived dirs OK
+    judged = run_doctor(d, max_age_s=120.0, now=NOW)
+    assert levels(judged)["heartbeats"] == "FAIL"
+    assert not judged["ok"] and judged["n_fail"] == 1
+    assert "verdict: NOT OK" in render_doctor(judged)
+
+
+def test_all_heartbeats_missing_with_expected_fails(tmp_path):
+    """A fully-dead run (zero heartbeat files, roster given) must FAIL —
+    the softer 'no heartbeat files' WARN would invert severity vs a
+    partially-dead run."""
+    d = synth_run_dir(tmp_path, beats={})
+    report = run_doctor(d, expected=2, now=NOW)
+    assert levels(report)["heartbeats"] == "FAIL"
+    assert "missing [0, 1]" in detail(report, "heartbeats")
+    assert "max age Nones" not in detail(report, "heartbeats")
+    assert not report["ok"]
+    # without a roster there is nothing to judge: WARN only
+    unjudged = run_doctor(d, now=NOW)
+    assert levels(unjudged)["heartbeats"] == "WARN"
+    assert unjudged["ok"]
+
+
+def test_step_skew_straggler_detection(tmp_path):
+    beats = {0: {"time": NOW - 5.0, "step": 4000},
+             1: {"time": NOW - 5.0, "step": 2400}}
+    d = synth_run_dir(tmp_path, beats=beats)
+    report = run_doctor(d, max_step_skew=1000, now=NOW)
+    assert levels(report)["step_skew"] == "WARN"
+    assert "straggler" in detail(report, "step_skew")
+    assert report["ok"]
+    loose = run_doctor(d, max_step_skew=2000, now=NOW)
+    assert levels(loose)["step_skew"] == "PASS"
+    # skew is reported (not judged) without the threshold
+    unjudged = run_doctor(d, now=NOW)
+    assert levels(unjudged)["step_skew"] == "PASS"
+    assert "1600" in detail(unjudged, "step_skew")
+
+
+def test_restart_count_from_resume_records(tmp_path):
+    d = synth_run_dir(tmp_path, resumes=2)
+    report = run_doctor(d, now=NOW)
+    assert levels(report)["restarts"] == "PASS"
+    assert "2 restart(s)" in detail(report, "restarts")
+    assert "step 1000" in detail(report, "restarts")
+
+
+def test_not_a_run_dir_fails(tmp_path):
+    report = run_doctor(str(tmp_path), now=NOW)
+    assert not report["ok"]
+    assert levels(report)["artifacts"] == "FAIL"
+
+
+def test_resolve_run_dir_descends_to_latest_numbered_run(tmp_path):
+    root = tmp_path / "results"
+    root.mkdir()
+    for name in ("00000-a", "00001-b"):
+        synth_run_dir(root, name=name)
+    assert resolve_run_dir(str(root)).endswith("00001-b")
+    # a real run dir resolves to itself
+    d = synth_run_dir(tmp_path, name="direct")
+    assert resolve_run_dir(d) == d
+
+
+def test_cli_doctor_json_modes(tmp_path, capsys):
+    d = synth_run_dir(tmp_path)
+    out_path = str(tmp_path / "doctor.json")
+    cli_main(["doctor", d, "--json", "--json-out", out_path])
+    printed = json.loads(capsys.readouterr().out)
+    archived = json.load(open(out_path))
+    assert printed == archived
+    assert printed["ok"] and printed["checks"]
+    # FAIL → exit 1
+    beats = {0: {"time": NOW - 500.0, "step": 1}}
+    bad = synth_run_dir(tmp_path, beats=beats, name="stale")
+    with pytest.raises(SystemExit) as e:
+        cli_main(["doctor", bad, "--max-age", "1e-6"])
+    assert e.value.code == 1

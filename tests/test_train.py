@@ -23,9 +23,15 @@ def micro_cfg(attention="simplex", batch=8):
                           w_dim=16, mapping_dim=16, mapping_layers=2,
                           fmap_base=64, fmap_max=32, attention=attention,
                           attn_start_res=8, attn_max_res=8, mbstd_group_size=4),
+        # device_time_ticks=0: the suite runs MANY short train()s — the
+        # sampler's profiler warm-up + traced tick would cost ~15 s per
+        # fresh process for nothing; the session-scoped micro_run_dir
+        # fixture (tests/conftest.py) re-enables it so the device-truth
+        # path is exercised exactly once.
         train=TrainConfig(batch_size=batch, total_kimg=1, d_reg_interval=2,
                           g_reg_interval=2, pl_batch_shrink=2,
-                          ema_kimg=0.01, style_mixing_prob=0.5),
+                          ema_kimg=0.01, style_mixing_prob=0.5,
+                          device_time_ticks=0),
         data=DataConfig(resolution=16, source="synthetic"),
         mesh=MeshConfig(),
     )
