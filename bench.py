@@ -606,6 +606,48 @@ def _run_scaling_outer() -> None:
         "error": (err or "no JSON from scaling child")[:1500]}))
 
 
+def steady_state_time(step, carry, n_it):
+    """THE validated steady-state timing loop (the r3-retraction
+    discipline), shared by the phase bench below and the satellite
+    benches (scripts/bench_pallas_attention.py) so every published
+    number inherits the same early-ack defenses.
+
+    ``step``: carry → (carry, out) — a donated-state train step chains
+    its state through ``carry``; a stateless kernel bench passes
+    ``carry=None`` and returns ``(None, result)``.
+
+    Returns ``(carry, per_it_s, tail_s)``:
+    * ``per_it_s`` — wall seconds per call to ``jax.block_until_ready``
+      (the reported block clock; one fetch RTT is NOT amortized in).
+    * ``tail_s``  — the post-block sync tail of a REAL device→host fetch
+      of a scalar data-dependent on the final call: an ack-early relay
+      cannot fake the value, so a tail comparable to the timed loop
+      means the loop wasn't finished when the clock stopped
+      (benchcheck.find_suspects / single_timer_suspects flag it).
+
+    Callers wanting the linearity defense re-invoke at 2× ``n_it`` and
+    hand both per-it times to the suspect check.
+    """
+    import jax
+    import numpy as np
+
+    t0 = time.time()
+    out = None
+    for _ in range(n_it):
+        carry, out = step(carry)
+    jax.block_until_ready(carry if carry is not None else out)
+    t_block = time.time()
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    if getattr(leaf, "ndim", 0):
+        # Device-index ONE element before fetching: the kernel benches'
+        # first leaf is a full gradient array, and a whole-tensor
+        # device_get would make tail_s measure host-transfer bandwidth
+        # instead of the sync tail the early-ack defense keys off.
+        leaf = leaf[(0,) * leaf.ndim]
+    float(np.asarray(jax.device_get(leaf)).ravel()[0])
+    return carry, (t_block - t0) / n_it, time.time() - t_block
+
+
 def build_cycle_artifact(*, metric: str, n_chips: int, platform: str,
                          bsz: int, k_cyc: int, per_call_s: float,
                          tail_s: float, n_calls: int, compile_s: float,
@@ -882,21 +924,16 @@ class _BenchSession:
             jax.block_until_ready(st.step)
 
             def timed(n_it):
-                """(per-it s to block_until_ready, post-block sync tail s).
-                The tail forces a real device→host transfer of a loss
-                scalar data-dependent on the final step — an ack-early
-                relay cannot fake the value, so a long tail exposes a
-                lying block clock (checked in build_phase_artifact)."""
+                """(per-it s, post-block sync tail s) via the shared
+                validated loop (``steady_state_time``, module level —
+                also the satellite benches' timer): the donated state
+                chains through the carry, the tail fetch reads a loss
+                scalar data-dependent on the final step (checked in
+                build_phase_artifact)."""
                 nonlocal st
-                t0 = time.time()
-                out = None
-                for _ in range(n_it):
-                    st, out = compiled(st, *extra)
-                jax.block_until_ready(st.step)
-                t_block = time.time()
-                float(np.asarray(jax.device_get(
-                    jax.tree_util.tree_leaves(out)[0])).ravel()[0])
-                return (t_block - t0) / n_it, time.time() - t_block
+                st, per_it, tail = steady_state_time(
+                    lambda carry: compiled(carry, *extra), st, n_it)
+                return per_it, tail
 
             timings[name], fetch_s[name] = timed(self.iters)
             _log(f"[b{bsz}] timed {name}: {timings[name] * 1e3:.1f} ms/step "

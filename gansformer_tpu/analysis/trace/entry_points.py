@@ -18,6 +18,14 @@ trace stands in for the flagship config at a fraction of the cost.
                   is traced without a third config.
 * ``tiny-bf16`` — bfloat16 compute path; the dtype-promotion member
                   (bf16→f32 upcasts only exist here).
+* ``tiny-pallas`` — attention_backend='pallas' (interpret mode off-TPU)
+                  on the DUPLEX model, so both kernel directions and
+                  their backward kernels sit inside the traced programs.
+                  Like tiny-bf16 it contributes only the superset
+                  programs (the second-order reg pair): the backend
+                  changes the attention compute path, not the step
+                  structure, so re-tracing the whole catalog would
+                  double cost for no new coverage (ISSUE 9).
 """
 
 from __future__ import annotations
@@ -33,14 +41,17 @@ _RES = 16
 
 
 def tiny_config(dtype: str = "float32", fused: bool = False,
-                attention: str = "simplex") -> ExperimentConfig:
+                attention: str = "simplex",
+                backend: str = "xla") -> ExperimentConfig:
     return ExperimentConfig(
-        name=f"trace-tiny-{dtype}{'-fused' if fused else ''}",
+        name=f"trace-tiny-{dtype}{'-fused' if fused else ''}"
+             f"{'-pallas' if backend == 'pallas' else ''}",
         model=ModelConfig(resolution=_RES, components=2, latent_dim=16,
                           w_dim=16, mapping_dim=16, mapping_layers=2,
                           fmap_base=64, fmap_max=32, attention=attention,
                           attn_start_res=8, attn_max_res=8,
-                          mbstd_group_size=2, dtype=dtype),
+                          mbstd_group_size=2, dtype=dtype,
+                          attention_backend=backend),
         train=TrainConfig(batch_size=_BATCH, total_kimg=1, d_reg_interval=2,
                           g_reg_interval=2, pl_batch_shrink=2, ema_kimg=0.01,
                           style_mixing_prob=0.5, fused_cycle=fused),
@@ -52,6 +63,10 @@ def trace_configs() -> Dict[str, ExperimentConfig]:
     return {
         "tiny-f32": tiny_config("float32"),
         "tiny-bf16": tiny_config("bfloat16"),
+        # duplex: both kernel directions (and both backward kernels) are
+        # inside the traced second-order programs (ISSUE 9)
+        "tiny-pallas": tiny_config("float32", attention="duplex",
+                                   backend="pallas"),
     }
 
 
@@ -221,7 +236,17 @@ def build_entry_points(config_name: str,
 FAST_MATRIX = {
     "tiny-f32": None,                       # all entry points
     "tiny-bf16": ["d_step_r1", "g_step_pl"],  # superset programs (R1+PL)
+    # pallas training backend (ISSUE 9): the second-order reg pair holds
+    # every kernel (fwd + bwd, both directions) inside real programs
+    "tiny-pallas": ["d_step_r1", "g_step_pl"],
 }
+
+
+# Under ``full`` the backend member still contributes only its superset
+# pair: the other five programs differ from tiny-f32's only inside the
+# attention compute (same step structure, same layouts), and every kernel
+# already sits inside the R1/PL programs.
+FULL_INCLUDE = {"tiny-pallas": ["d_step_r1", "g_step_pl"]}
 
 
 def build_matrix(profile: str = "fast") -> List[EntryPoint]:
@@ -231,5 +256,6 @@ def build_matrix(profile: str = "fast") -> List[EntryPoint]:
             out.extend(build_entry_points(cname, include=include))
     else:
         for cname in trace_configs():
-            out.extend(build_entry_points(cname))
+            out.extend(build_entry_points(cname,
+                                          include=FULL_INCLUDE.get(cname)))
     return out

@@ -41,6 +41,20 @@ def build_parser() -> argparse.ArgumentParser:
     # training overrides
     p.add_argument("--batch-size", type=int)
     p.add_argument("--total-kimg", type=int)
+    # Tri-state like the other model flags: None inherits the loaded
+    # config.  'pallas' = the fused blockwise kernels with backward
+    # kernels + second-order derivative rule (ops/pallas_attention.py) —
+    # training-grade since ISSUE 9.  On TPU the request is resolved
+    # through the native smoke check (fwd AND bwd kernels) before any
+    # step program compiles; a failed check falls back to 'xla' with the
+    # reason printed, matching the config rule's wording.
+    p.add_argument("--attention-backend", default=None,
+                   choices=("xla", "pallas"),
+                   help="attention compute backend for the train step "
+                        "programs ('pallas' = fused differentiable "
+                        "kernels; on TPU a failed native smoke check "
+                        "falls back to xla with the reason printed; "
+                        "default: inherit the loaded config)")
     p.add_argument("--g-lr", type=float)
     p.add_argument("--d-lr", type=float)
     p.add_argument("--r1-gamma", type=float)
@@ -179,6 +193,9 @@ def config_from_args(args) -> ExperimentConfig:
     fkv = getattr(args, "attn_fused_kv", None)
     if fkv is not None:           # tri-state: None inherits the config
         model = dataclasses.replace(model, attn_fused_kv=fkv)
+    ab = getattr(args, "attention_backend", None)
+    if ab is not None:            # tri-state: None inherits the config
+        model = dataclasses.replace(model, attention_backend=ab)
     train = override(cfg.train, batch_size=args.batch_size,
                      total_kimg=args.total_kimg, g_lr=args.g_lr,
                      d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed,
@@ -263,6 +280,41 @@ def main(argv=None) -> None:
     from gansformer_tpu.utils.hostenv import enable_compile_cache
 
     enable_compile_cache()   # warm second-order compiles across invocations
+    if cfg.model.attention_backend == "pallas":
+        # The smoke-check-and-fall-back discipline (ADVICE r3), now on the
+        # TRAINING entry point: resolve before any step program compiles,
+        # so a Mosaic regression costs one tiny compile + a clear message
+        # instead of a failed multi-minute second-order compile.  The
+        # resolved backend lands in the saved config.json — a resumed run
+        # re-resolves from its own record, never from a stale request.
+        import sys as _sys
+
+        from gansformer_tpu.ops.pallas_attention import resolve_backend
+
+        resolved = resolve_backend("pallas")
+        if jax.process_count() > 1:
+            # Every host must land on the SAME backend: the smoke check
+            # runs per-process, and a host-local failure (transient
+            # compile-cache corruption, flaky Mosaic lowering) would
+            # otherwise leave this host compiling xla step programs while
+            # its peers compile pallas ones — the job then hangs at the
+            # first collective instead of failing cleanly.  AND-reduce
+            # the verdict, same discipline as the run-id / selfcheck
+            # broadcasts below.
+            from jax.experimental import multihost_utils
+            import numpy as np
+
+            oks = multihost_utils.process_allgather(
+                np.int32(resolved == "pallas"))
+            if int(np.min(oks)) == 0:
+                resolved = "xla"
+        if resolved != "pallas":
+            print("[train] --attention-backend pallas requested but the "
+                  "native TPU smoke check failed on at least one host "
+                  "(reason on its stderr); training continues on "
+                  "attention_backend='xla'", file=_sys.stderr)
+            cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+                cfg.model, attention_backend=resolved))
     is_main = jax.process_index() == 0
     if run_dir is None:
         desc = args.desc or f"{cfg.name}-{cfg.model.attention}-k{cfg.model.components}"

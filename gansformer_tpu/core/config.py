@@ -81,10 +81,13 @@ class ModelConfig:
     # Needs mesh.model > 1 and an ambient ``jax.sharding.set_mesh``; the
     # trainer and dryrun arrange both.
     sequence_parallel: bool = False
-    # 'xla' | 'pallas' — attention compute backend.  'pallas' uses the fused
-    # blockwise kernels (ops/pallas_attention.py): forward-only, so it is
-    # for sampling / metric sweeps (generate/evaluate --attention-backend),
-    # never the training step.
+    # 'xla' | 'pallas' — attention compute backend.  'pallas' uses the
+    # fused blockwise kernels (ops/pallas_attention.py), differentiable to
+    # second order since ISSUE 9, so it is valid for BOTH the forward-only
+    # paths (generate/evaluate --attention-backend) and the four training
+    # step programs (cli/train.py --attention-backend).  On TPU the first
+    # use runs the native smoke check (fwd + bwd kernels) and the CLIs
+    # fall back to 'xla' with the printed reason if Mosaic lowering fails.
     attention_backend: str = "xla"
     # MFU lever (ISSUE 5, default OFF): fuse the attention K/V projections
     # into ONE matmul per direction — the duplex centroid phase's k_x/v_x
@@ -312,13 +315,24 @@ class ExperimentConfig:
         if m.integration not in ("add", "mul", "both"):
             errs.append(f"model.integration must be add|mul|both, "
                         f"got {m.integration!r}")
-        if m.attention_backend != "xla":
-            # validate() gates the TRAINING entry points; the pallas
-            # kernels are forward-only (generate/evaluate override the
-            # backend after restore, without validate).
-            errs.append(f"training requires model.attention_backend='xla' "
-                        f"(got {m.attention_backend!r}); 'pallas' is for "
-                        f"the forward-only generate/evaluate paths")
+        if m.attention_backend not in ("xla", "pallas"):
+            # Both backends are training-grade: the pallas kernels carry
+            # backward kernels + a second-order derivative rule (ISSUE 9;
+            # ops/pallas_attention.py).  On TPU the train CLI resolves
+            # 'pallas' through the native smoke check first and falls
+            # back to 'xla' with the reason if it fails.
+            errs.append(f"model.attention_backend must be xla|pallas, "
+                        f"got {m.attention_backend!r}")
+        if m.attention_backend == "pallas" and m.sequence_parallel:
+            # The pallas_call has no sharding rule: on a grid sharded over
+            # the model axis GSPMD would all-gather the full n axis per
+            # device, silently un-doing exactly the memory bound
+            # sequence_parallel exists for.  Reject until a sharded kernel
+            # path exists (shard_map over the n grid).
+            errs.append("model.attention_backend='pallas' does not yet "
+                        "have a sequence-parallel (model-axis-sharded) "
+                        "kernel path; use attention_backend='xla' with "
+                        "sequence_parallel, or drop sequence_parallel")
         if m.dtype not in ("float32", "bfloat16"):
             errs.append(f"model.dtype must be float32|bfloat16, "
                         f"got {m.dtype!r}")

@@ -58,9 +58,10 @@ class BipartiteAttention(nn.Module):
     # writes by hand (tests hold the two to parity).  Requires an ambient
     # mesh (``jax.sharding.set_mesh``) when enabled.
     grid_shard: bool = False
-    # 'xla' (jnp composite, differentiable — the training path) or 'pallas'
-    # (fused blockwise kernels, forward-only — sampling/metric sweeps;
-    # ops/pallas_attention.py).  Pallas path sows no probability maps.
+    # 'xla' (jnp composite) or 'pallas' (fused blockwise kernels with
+    # backward kernels + a second-order derivative rule — training-grade
+    # since ISSUE 9; ops/pallas_attention.py).  The pallas path sows no
+    # probability maps, so attention-overlay collection needs 'xla'.
     backend: str = "xla"
     # MFU lever (ModelConfig.attn_fused_kv, ISSUE 5): one K∥V projection
     # matmul per direction instead of two.  Exact math (concatenated

@@ -1,33 +1,63 @@
-"""Pallas TPU kernels for the bipartite attention (SURVEY.md §2.4 "Ring
-attention / blockwise" row: blockwise kernel over the n = H·W grid axis to
-bound VMEM at high resolution — no ring needed).
+"""Pallas TPU kernels for the bipartite attention — now differentiable to
+second order, so ``attention_backend='pallas'`` is a TRAINING backend, not
+just a sampling one (SURVEY.md §2.4 "Ring attention / blockwise" row:
+blockwise kernels over the n = H·W grid axis to bound VMEM at high
+resolution — no ring needed).
 
-Two directions, two kernels:
+Two directions, each with a forward and a backward kernel:
 
 ``grid_to_latent_attention``  — X←Y (the main phase): every grid position
     attends to the k ≤ 33 latents.  The softmax axis is the tiny k, so each
     n-block is independent: one fused kernel computes logits → softmax →
     value mix without ever materializing the [n, k] probability map in HBM.
-    Memory traffic drops from (read q,k,v + write logits + read logits +
-    write probs + read probs + write out) to (read q,k,v + write out).
+    The forward also emits the per-row softmax statistic ``lse`` (row max +
+    log denominator, one fp32 scalar per grid position) — the residual the
+    backward kernel needs to RECOMPUTE probabilities blockwise instead of
+    reading a saved map.  The backward kernel walks the same n-blocks,
+    rebuilds P = exp(S − lse) per block, and produces dq per block plus
+    dk/dv accumulated across blocks in fp32 VMEM scratch.
 
 ``latent_to_grid_attention``  — Y←X (the duplex centroid phase): the k
     latents attend OVER the n grid positions, so the softmax spans n.  The
-    kernel runs blockwise over n with running max / denominator / weighted
-    accumulator (the flash-attention recurrence) in VMEM scratch — VMEM use
-    is O(block_n · D) regardless of n, which is what makes 1024² (n = 1M at
-    the finest attended resolution) feasible without spilling.
+    forward runs blockwise with running max / denominator / weighted
+    accumulator (the flash-attention recurrence) in VMEM scratch and emits
+    ``lse`` at the final block.  The backward is the flash-attention
+    backward recurrence: per n-block it recomputes P from ``lse``, uses the
+    FlashAttention delta trick (rowsum(dP ∘ P) = rowsum(do ∘ o), computed
+    once outside the kernel from the saved output), writes dk/dv for the
+    block, and accumulates dq in VMEM scratch — the [k, n] map is never
+    materialized in either pass.
 
-Both kernels are forward-path only and are wired into sampling / metric
-sweeps (``ModelConfig.attention_backend = 'pallas'``); the training path
-stays on the jnp composite (``ops.attention.multihead_attention``) because
-R1/path-length need second-order autodiff, which a ``custom_vjp`` around an
-opaque kernel would break (SURVEY.md §7.3 item 1).  Tests run the kernels in
-interpret mode on CPU against the jnp oracle; on TPU, native Mosaic lowering
-is where interpret-mode coverage can diverge (the (L,1) fp32 scratch shapes,
-``@pl.when`` accumulation), so first use on a TPU runs ``tpu_smoke_check``
-— a tiny native compile-and-compare against the jnp oracle — and the CLIs
-fall back to the xla backend with a warning if it fails (ADVICE r3).
+Autodiff contract (the reason training can use these; docs/kernels.md has
+the full derivation):
+
+* The public ops are ``jax.custom_vjp`` functions whose bwd runs the
+  backward kernels — first-order reverse-mode (the ``d``/``g`` step
+  programs' hot path) executes kernels only.
+* Every kernel composite inside fwd/bwd is itself a ``jax.custom_jvp``
+  function whose rule computes the primal via the kernels (decorated
+  recursion — one transform level peels per call) and the tangent via
+  ``jax.jvp`` of the jnp reference formula.  ``custom_jvp_call`` survives
+  in jaxprs, so when the lazy-reg programs linearize the first-order graph
+  (R1's grad-of-grad, PL's HVP through synthesis) they re-enter these
+  rules instead of hitting a raw ``pallas_call`` — which has no transpose
+  rule and would abort the trace.  A plain ``custom_vjp`` without the
+  inner jvp layer fails exactly there (verified; the jnp tangent glue
+  materializes one [n, k] map, but only inside the 1/16-, 1/4-cadence reg
+  programs).
+* Direct forward-mode (``jax.jvp`` straight through the op) is NOT
+  supported — the ``custom_vjp`` wrapper rejects it.  Nothing in the
+  training/eval stack forward-diffs through attention (R1/PL are both
+  formulated as reverse-mode grads, losses/gan.py).
+
+Tests run the kernels in interpret mode on CPU against the jnp oracle
+(forward, dq/dk/dv, and an R1-shaped double backward); on TPU, native
+Mosaic lowering is where interpret-mode coverage can diverge (the (L,1)
+fp32 scratch shapes, ``@pl.when`` accumulation, the new multi-output
+blocks), so first use on a TPU runs ``tpu_smoke_check`` — a tiny native
+compile-and-compare of the forward AND backward kernels against the jnp
+oracle — and the CLIs fall back to the xla backend with a warning if it
+fails (ADVICE r3).
 """
 
 from __future__ import annotations
@@ -46,11 +76,57 @@ def _vmem():
 
 
 # --------------------------------------------------------------------------
+# jnp reference formulas — the oracle math the kernels implement.  They are
+# BOTH the parity baseline (tests) and the tangent glue of the custom_jvp
+# rules below: higher-order transforms differentiate these, so they stay in
+# fp32 stats exactly like ops.attention.multihead_attention.
+# --------------------------------------------------------------------------
+
+
+def _ref_fwd_stats(q, k, v):
+    """softmax(q kᵀ/√D) v with the row statistic: returns (o, lse)."""
+    s = jnp.einsum("bnd,bld->bnl", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(q.shape[-1])
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    den = jnp.sum(e, axis=-1, keepdims=True)
+    o = jnp.einsum("bnl,bld->bnd", (e / den).astype(v.dtype), v,
+                   preferred_element_type=jnp.float32).astype(v.dtype)
+    return o, (m + jnp.log(den))[..., 0]
+
+
+def _ref_bwd(q, k, v, lse, do):
+    """VJP of softmax attention at cotangent ``do``, probabilities
+    recomputed from ``lse`` (the formula both bwd kernels implement)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+    v32, do32 = v.astype(jnp.float32), do.astype(jnp.float32)
+    s = jnp.einsum("bnd,bld->bnl", q32, k32) * scale
+    p = jnp.exp(s - lse[..., None])
+    dv = jnp.einsum("bnl,bnd->bld", p, do32)
+    dp = jnp.einsum("bnd,bld->bnl", do32, v32)
+    delta = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bnl,bld->bnd", ds, k32) * scale
+    dk = jnp.einsum("bnl,bnd->bld", ds, q32) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _ref_bwd_with_o(q, k, v, o, lse, do):
+    del o  # the delta identity rowsum(dP∘P) == rowsum(do∘o) is kernel-side
+    return _ref_bwd(q, k, v, lse, do)
+
+
+# --------------------------------------------------------------------------
 # X ← Y : grid attends to latents (softmax over the tiny latent axis)
 # --------------------------------------------------------------------------
 
-def _grid_to_latent_kernel(q_ref, k_ref, v_ref, o_ref, *, scale):
+def _grid_to_latent_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *,
+                           scale):
     # q: [1, bn, D]  k: [1, L, D]  v: [1, L, Dv]  o: [1, bn, Dv]
+    # lse: [1, bn] — row max + log denominator, the backward's residual.
+    # None on the no-grad path (generate/evaluate): pallas_call cannot
+    # DCE an unused output, so the sampling path must not declare one.
     q = q_ref[0].astype(jnp.float32)
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0]
@@ -58,26 +134,29 @@ def _grid_to_latent_kernel(q_ref, k_ref, v_ref, o_ref, *, scale):
         q, k, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale        # [bn, L]
     m = jnp.max(logits, axis=-1, keepdims=True)
-    p = jnp.exp(logits - m)
-    p = p / jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.dot(p.astype(v.dtype), v,
+    e = jnp.exp(logits - m)
+    den = jnp.sum(e, axis=-1, keepdims=True)
+    o = jnp.dot((e / den).astype(v.dtype), v,
                 preferred_element_type=jnp.float32)         # [bn, Dv]
     o_ref[0] = o.astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse_ref[0] = (m + jnp.log(den))[:, 0]
 
 
-def grid_to_latent_attention(
+def _grid_to_latent_fwd(
     q: jax.Array,    # [B, n, D]   (fold heads into B; D = head dim)
     k: jax.Array,    # [B, L, D]
     v: jax.Array,    # [B, L, Dv]
     *,
     block_n: int = 512,
     interpret: bool = False,
-) -> jax.Array:
-    """Fused attention where softmax runs over the latent axis L.
-
-    Equivalent to ``softmax(q @ k.T / sqrt(D)) @ v`` — the main-phase
-    direction of ``ops.attention.multihead_attention`` (per head).
-    """
+    with_stats: bool = True,
+):
+    """Fused forward where softmax runs over the latent axis L; returns
+    ``(out, lse)`` — lse is the fp32 softmax statistic per grid row.
+    ``with_stats=False`` (the no-grad sampling path) declares only the
+    ``out`` output: pallas_call cannot DCE an unused output, so the lse
+    HBM write must be omitted at declaration, not ignored downstream."""
     b, n, d = q.shape
     _, l, dv = v.shape
     scale = 1.0 / math.sqrt(d)
@@ -86,9 +165,16 @@ def grid_to_latent_attention(
     if n_pad:
         q = jnp.pad(q, ((0, 0), (0, n_pad), (0, 0)))
     grid = (b, (n + n_pad) // bn)
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b, n + n_pad, dv), v.dtype)]
+    out_specs = [pl.BlockSpec((1, bn, dv), lambda i, j: (i, j, 0),
+                              memory_space=_vmem())]
+    if with_stats:
+        out_shape.append(jax.ShapeDtypeStruct((b, n + n_pad), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, bn), lambda i, j: (i, j),
+                                      memory_space=_vmem()))
+    res = pl.pallas_call(
         functools.partial(_grid_to_latent_kernel, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((b, n + n_pad, dv), v.dtype),
+        out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bn, d), lambda i, j: (i, j, 0),
@@ -98,18 +184,108 @@ def grid_to_latent_attention(
             pl.BlockSpec((1, l, dv), lambda i, j: (i, 0, 0),
                          memory_space=_vmem()),
         ],
-        out_specs=pl.BlockSpec((1, bn, dv), lambda i, j: (i, j, 0),
-                               memory_space=_vmem()),
+        out_specs=tuple(out_specs),
         interpret=interpret,
     )(q, k, v)
-    return out[:, :n]
+    if not with_stats:
+        return res[0][:, :n]
+    out, lse = res
+    return out[:, :n], lse[:, :n]
+
+
+def _grid_to_latent_bwd_kernel(q_ref, k_ref, v_ref, lse_ref, do_ref,
+                               dq_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                               *, scale):
+    # Per n-block: rebuild P from lse, emit dq for the block, accumulate
+    # dk/dv across blocks in fp32 scratch (same revisiting discipline as
+    # the latent_to_grid forward).  Padded tail rows are safe: q rows are
+    # zero → P is a finite uniform row, and do rows are zero → their
+    # dk/dv contributions vanish (dP = 0 ⇒ dS = 0).
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # [bn, L]
+    p = jnp.exp(s - lse_ref[0][:, None])
+    dv_acc[:] += jax.lax.dot_general(
+        p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [L, Dv]
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [bn, L]
+    delta = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq_ref[0] = (jnp.dot(ds, k, preferred_element_type=jnp.float32)
+                 * scale).astype(dq_ref.dtype)
+    dk_acc[:] += jax.lax.dot_general(
+        ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # [L, D]
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _grid_to_latent_bwd(q, k, v, lse, do, *, block_n: int = 512,
+                        interpret: bool = False):
+    """(dq, dk, dv) of the X←Y direction — probabilities recomputed
+    blockwise from ``lse``; the [n, L] map never touches HBM."""
+    b, n, d = q.shape
+    _, l, dv = v.shape
+    scale = 1.0 / math.sqrt(d)
+    bn = min(block_n, n)
+    n_pad = -n % bn
+    if n_pad:
+        q = jnp.pad(q, ((0, 0), (0, n_pad), (0, 0)))
+        do = jnp.pad(do, ((0, 0), (0, n_pad), (0, 0)))
+        lse = jnp.pad(lse, ((0, 0), (0, n_pad)))
+    grid = (b, (n + n_pad) // bn)
+    dq, dk, dvv = pl.pallas_call(
+        functools.partial(_grid_to_latent_bwd_kernel, scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((b, n + n_pad, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, l, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, l, dv), v.dtype)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bn, d), lambda i, j: (i, j, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, l, dv), lambda i, j: (i, 0, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, bn), lambda i, j: (i, j),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, bn, dv), lambda i, j: (i, j, 0),
+                         memory_space=_vmem()),
+        ],
+        out_specs=(pl.BlockSpec((1, bn, d), lambda i, j: (i, j, 0),
+                                memory_space=_vmem()),
+                   pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0),
+                                memory_space=_vmem()),
+                   pl.BlockSpec((1, l, dv), lambda i, j: (i, 0, 0),
+                                memory_space=_vmem())),
+        scratch_shapes=[pltpu.VMEM((l, d), jnp.float32),
+                        pltpu.VMEM((l, dv), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, lse, do)
+    return dq[:, :n], dk, dvv
 
 
 # --------------------------------------------------------------------------
 # Y ← X : latents attend over the grid (online softmax over the big n axis)
 # --------------------------------------------------------------------------
 
-def _latent_to_grid_kernel(q_ref, k_ref, v_ref, o_ref,
+def _latent_to_grid_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                            m_ref, s_ref, acc_ref, *, scale, n_valid, block_n):
     # q: [1, L, D]  k: [1, bn, D]  v: [1, bn, Dv]  o: [1, L, Dv]
     # scratch: m [L, 1], s [L, 1], acc [L, Dv]  (flash recurrence, fp32)
@@ -146,6 +322,288 @@ def _latent_to_grid_kernel(q_ref, k_ref, v_ref, o_ref,
     @pl.when(j == pl.num_programs(1) - 1)
     def _emit():
         o_ref[0] = (acc_ref[:] / s_ref[:]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0] = (m_ref[:] + jnp.log(s_ref[:]))[:, 0]
+
+
+def _latent_to_grid_kernel_nostats(q_ref, k_ref, v_ref, o_ref,
+                                   m_ref, s_ref, acc_ref, **kw):
+    # No-grad sampling path: with one declared output the refs pallas
+    # passes shift left, so lse's slot must vanish from the signature.
+    _latent_to_grid_kernel(q_ref, k_ref, v_ref, o_ref, None,
+                           m_ref, s_ref, acc_ref, **kw)
+
+
+def _latent_to_grid_fwd(
+    q: jax.Array,    # [B, L, D]
+    k: jax.Array,    # [B, n, D]
+    v: jax.Array,    # [B, n, Dv]
+    *,
+    block_n: int = 512,
+    interpret: bool = False,
+    with_stats: bool = True,
+):
+    """Fused forward where softmax runs over the grid axis n, blockwise
+    with the flash-attention online recurrence (VMEM bounded by block_n);
+    returns ``(out, lse)``.  ``with_stats=False`` (the no-grad sampling
+    path) declares only ``out`` — see ``_grid_to_latent_fwd``."""
+    b, l, d = q.shape
+    _, n, dv = v.shape
+    scale = 1.0 / math.sqrt(d)
+    bn = min(block_n, n)
+    n_pad = -n % bn
+    if n_pad:
+        k = jnp.pad(k, ((0, 0), (0, n_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, n_pad), (0, 0)))
+    grid = (b, (n + n_pad) // bn)
+    kern = functools.partial(
+        _latent_to_grid_kernel if with_stats else _latent_to_grid_kernel_nostats,
+        scale=scale, n_valid=n, block_n=bn)
+    scratch = [pltpu.VMEM((l, 1), jnp.float32),
+               pltpu.VMEM((l, 1), jnp.float32),
+               pltpu.VMEM((l, dv), jnp.float32)]
+    out_shape = [jax.ShapeDtypeStruct((b, l, dv), v.dtype)]
+    out_specs = [pl.BlockSpec((1, l, dv), lambda i, j: (i, 0, 0),
+                              memory_space=_vmem())]
+    if with_stats:
+        out_shape.append(jax.ShapeDtypeStruct((b, l), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, l), lambda i, j: (i, 0),
+                                      memory_space=_vmem()))
+    res = pl.pallas_call(
+        kern,
+        out_shape=tuple(out_shape),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, bn, d), lambda i, j: (i, j, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, bn, dv), lambda i, j: (i, j, 0),
+                         memory_space=_vmem()),
+        ],
+        out_specs=tuple(out_specs),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+    return res if with_stats else res[0]
+
+
+def _latent_to_grid_bwd_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref,
+                               do_ref, dq_ref, dk_ref, dv_ref, dq_acc,
+                               *, scale, n_valid, block_n):
+    # The flash backward recurrence: P rebuilt per n-block from lse;
+    # delta = rowsum(do ∘ o) (the FlashAttention identity for
+    # rowsum(dP ∘ P), computed once outside); dk/dv written per block,
+    # dq accumulated in fp32 scratch and emitted at the last block.
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # [L, bn]
+    offs = j * block_n + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, dimension=1)
+    # Masked (padded) columns: P = 0 kills their dk/dv rows and their
+    # dq contribution in one stroke.
+    p = jnp.where(offs < n_valid, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+    dv_ref[0] = jax.lax.dot_general(
+        p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [L, bn]
+    ds = p * (dp - delta_ref[0][:, None])
+    dk_ref[0] = (jax.lax.dot_general(
+        ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale).astype(dk_ref.dtype)
+    dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _emit():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _latent_to_grid_bwd(q, k, v, o, lse, do, *, block_n: int = 512,
+                        interpret: bool = False):
+    """(dq, dk, dv) of the Y←X direction via the flash backward
+    recurrence; the [L, n] map never touches HBM."""
+    b, l, d = q.shape
+    _, n, dv = v.shape
+    scale = 1.0 / math.sqrt(d)
+    # FlashAttention delta trick: rowsum(dP ∘ P) == rowsum(do ∘ o), so the
+    # cross-block softmax correction is a [B, L] vector computed from the
+    # saved output — no second pass over the grid axis.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    bn = min(block_n, n)
+    n_pad = -n % bn
+    if n_pad:
+        k = jnp.pad(k, ((0, 0), (0, n_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, n_pad), (0, 0)))
+    grid = (b, (n + n_pad) // bn)
+    dq, dk, dvv = pl.pallas_call(
+        functools.partial(_latent_to_grid_bwd_kernel, scale=scale,
+                          n_valid=n, block_n=bn),
+        out_shape=(jax.ShapeDtypeStruct((b, l, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, n + n_pad, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, n + n_pad, dv), v.dtype)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, bn, d), lambda i, j: (i, j, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, bn, dv), lambda i, j: (i, j, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, l), lambda i, j: (i, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, l), lambda i, j: (i, 0),
+                         memory_space=_vmem()),
+            pl.BlockSpec((1, l, dv), lambda i, j: (i, 0, 0),
+                         memory_space=_vmem()),
+        ],
+        out_specs=(pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0),
+                                memory_space=_vmem()),
+                   pl.BlockSpec((1, bn, d), lambda i, j: (i, j, 0),
+                                memory_space=_vmem()),
+                   pl.BlockSpec((1, bn, dv), lambda i, j: (i, j, 0),
+                                memory_space=_vmem())),
+        scratch_shapes=[pltpu.VMEM((l, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, lse, delta, do)
+    return dq, dk[:, :n], dvv[:, :n]
+
+
+# --------------------------------------------------------------------------
+# Derivative rules.  Layering (see module docstring + docs/kernels.md):
+#   custom_vjp  — first-order reverse runs the bwd kernels (the hot path);
+#   custom_jvp  — every kernel composite re-enters a rule under further
+#                 linearization (R1 grad-of-grad, PL HVP) instead of
+#                 exposing an untransposable raw pallas_call.
+# The jvp rules compute the primal by calling THEMSELVES (decorated
+# recursion peels exactly one transform level per call, bottoming out at
+# the kernels) and the tangent via jax.jvp of the jnp reference — correct
+# by construction and linear in the tangents, hence transposable.
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(3, 4))
+def _g2l_stats(q, k, v, block_n, interpret):
+    return _grid_to_latent_fwd(q, k, v, block_n=block_n, interpret=interpret)
+
+
+@_g2l_stats.defjvp
+def _g2l_stats_jvp(block_n, interpret, primals, tangents):
+    out = _g2l_stats(*primals, block_n, interpret)
+    _, tan = jax.jvp(_ref_fwd_stats, primals, tangents)
+    return out, tan
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(5, 6))
+def _g2l_grads(q, k, v, lse, do, block_n, interpret):
+    return _grid_to_latent_bwd(q, k, v, lse, do, block_n=block_n,
+                               interpret=interpret)
+
+
+@_g2l_grads.defjvp
+def _g2l_grads_jvp(block_n, interpret, primals, tangents):
+    out = _g2l_grads(*primals, block_n, interpret)
+    _, tan = jax.jvp(_ref_bwd, primals, tangents)
+    return out, tan
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(3, 4))
+def _l2g_stats(q, k, v, block_n, interpret):
+    return _latent_to_grid_fwd(q, k, v, block_n=block_n, interpret=interpret)
+
+
+@_l2g_stats.defjvp
+def _l2g_stats_jvp(block_n, interpret, primals, tangents):
+    out = _l2g_stats(*primals, block_n, interpret)
+    _, tan = jax.jvp(_ref_fwd_stats, primals, tangents)
+    return out, tan
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(6, 7))
+def _l2g_grads(q, k, v, o, lse, do, block_n, interpret):
+    return _latent_to_grid_bwd(q, k, v, o, lse, do, block_n=block_n,
+                               interpret=interpret)
+
+
+@_l2g_grads.defjvp
+def _l2g_grads_jvp(block_n, interpret, primals, tangents):
+    out = _l2g_grads(*primals, block_n, interpret)
+    _, tan = jax.jvp(_ref_bwd_with_o, primals, tangents)
+    return out, tan
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _g2l_attend(q, k, v, block_n, interpret):
+    # Primal = the no-grad path (generate/evaluate/vmap): the lse-free
+    # kernel, so sampling never pays the backward residual's HBM write.
+    # Differentiation always enters through the fwd/bwd rule below.
+    return _grid_to_latent_fwd(q, k, v, block_n=block_n,
+                               interpret=interpret, with_stats=False)
+
+
+def _g2l_attend_fwd(q, k, v, block_n, interpret):
+    o, lse = _g2l_stats(q, k, v, block_n, interpret)
+    return o, (q, k, v, lse)
+
+
+def _g2l_attend_bwd(block_n, interpret, res, ct):
+    q, k, v, lse = res
+    return _g2l_grads(q, k, v, lse, ct, block_n, interpret)
+
+
+_g2l_attend.defvjp(_g2l_attend_fwd, _g2l_attend_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _l2g_attend(q, k, v, block_n, interpret):
+    # Primal = the no-grad path: lse-free kernel (see _g2l_attend).
+    return _latent_to_grid_fwd(q, k, v, block_n=block_n,
+                               interpret=interpret, with_stats=False)
+
+
+def _l2g_attend_fwd(q, k, v, block_n, interpret):
+    o, lse = _l2g_stats(q, k, v, block_n, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _l2g_attend_bwd(block_n, interpret, res, ct):
+    q, k, v, o, lse = res
+    return _l2g_grads(q, k, v, o, lse, ct, block_n, interpret)
+
+
+_l2g_attend.defvjp(_l2g_attend_fwd, _l2g_attend_bwd)
+
+
+# --------------------------------------------------------------------------
+# Public ops — same signatures as before, now differentiable to 2nd order.
+# --------------------------------------------------------------------------
+
+def grid_to_latent_attention(
+    q: jax.Array,    # [B, n, D]   (fold heads into B; D = head dim)
+    k: jax.Array,    # [B, L, D]
+    v: jax.Array,    # [B, L, Dv]
+    *,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused attention where softmax runs over the latent axis L.
+
+    Equivalent to ``softmax(q @ k.T / sqrt(D)) @ v`` — the main-phase
+    direction of ``ops.attention.multihead_attention`` (per head).
+    Differentiable to second order (reverse-mode; see module docstring).
+    """
+    return _g2l_attend(q, k, v, block_n, interpret)
 
 
 def latent_to_grid_attention(
@@ -157,38 +615,9 @@ def latent_to_grid_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """Fused attention where softmax runs over the grid axis n, blockwise
-    with the flash-attention online recurrence (VMEM bounded by block_n)."""
-    b, l, d = q.shape
-    _, n, dv = v.shape
-    scale = 1.0 / math.sqrt(d)
-    bn = min(block_n, n)
-    n_pad = -n % bn
-    if n_pad:
-        k = jnp.pad(k, ((0, 0), (0, n_pad), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, n_pad), (0, 0)))
-    grid = (b, (n + n_pad) // bn)
-    kern = functools.partial(_latent_to_grid_kernel, scale=scale,
-                             n_valid=n, block_n=bn)
-    scratch = [pltpu.VMEM((l, 1), jnp.float32),
-               pltpu.VMEM((l, 1), jnp.float32),
-               pltpu.VMEM((l, dv), jnp.float32)]
-    return pl.pallas_call(
-        kern,
-        out_shape=jax.ShapeDtypeStruct((b, l, dv), v.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0),
-                         memory_space=_vmem()),
-            pl.BlockSpec((1, bn, d), lambda i, j: (i, j, 0),
-                         memory_space=_vmem()),
-            pl.BlockSpec((1, bn, dv), lambda i, j: (i, j, 0),
-                         memory_space=_vmem()),
-        ],
-        out_specs=pl.BlockSpec((1, l, dv), lambda i, j: (i, 0, 0),
-                               memory_space=_vmem()),
-        scratch_shapes=scratch,
-        interpret=interpret,
-    )(q, k, v)
+    with the flash-attention online recurrence (VMEM bounded by block_n).
+    Differentiable to second order (reverse-mode; see module docstring)."""
+    return _l2g_attend(q, k, v, block_n, interpret)
 
 
 # --------------------------------------------------------------------------
@@ -207,7 +636,8 @@ def multihead_attention_pallas(
     """Head-folding wrapper: picks the kernel by which side is the grid.
 
     Returns out [N, Lq, Dv] only (no probability maps — use the jnp op when
-    attention visualizations are being collected).
+    attention visualizations are being collected).  The fold is plain
+    reshape/transpose, so the wrapper inherits the kernels' autodiff.
     """
     n, lq, d = q.shape
     _, lk, dv = v.shape
@@ -239,13 +669,17 @@ _TPU_SMOKE: dict = {}   # memo: {'ok': bool, 'detail': str}
 
 
 def tpu_smoke_check(atol: float = 1e-2) -> tuple:
-    """Compile both kernels NATIVELY on the ambient TPU at tiny shapes and
+    """Compile the kernels NATIVELY on the ambient TPU at tiny shapes and
     compare against the jnp oracle.  Returns ``(ok, detail)``; memoized so
-    the cost (two small compiles) is paid once per process.
+    the cost (a handful of small compiles) is paid once per process.
 
-    Exercises both directions, multi-head folding, and the blockwise path
-    with a non-divisible n (padding + masked flash recurrence) — exactly the
-    constructs where Mosaic lowering could diverge from interpret mode.
+    Exercises both directions, multi-head folding, the blockwise path with
+    a non-divisible n (padding + masked flash recurrence), AND — now that
+    training runs on these kernels — the backward kernels via a
+    ``jax.grad`` through each direction: the (L,1) scratch shapes,
+    ``@pl.when`` accumulation, and the new multi-output (o, lse) blocks
+    are exactly the constructs where Mosaic lowering could diverge from
+    interpret mode.
     """
     if "ok" in _TPU_SMOKE:
         return _TPU_SMOKE["ok"], _TPU_SMOKE["detail"]
@@ -269,9 +703,29 @@ def tpu_smoke_check(atol: float = 1e-2) -> tuple:
                                             interpret=False)
         d_xy = float(jnp.max(jnp.abs(got_xy - ref_xy)))
         d_yx = float(jnp.max(jnp.abs(got_yx - ref_yx)))
-        ok = d_xy < atol and d_yx < atol
-        detail = (f"max_abs_diff grid_to_latent={d_xy:.2e} "
-                  f"latent_to_grid={d_yx:.2e} (atol {atol:g})")
+        # Backward kernels (the training path): grad of a scalar through
+        # each direction vs the differentiable jnp composite.
+        def loss_pl(q, k, v, heads, bn):
+            out = multihead_attention_pallas(q, k, v, heads, block_n=bn,
+                                             interpret=False)
+            return jnp.sum(out * jnp.cos(out))
+
+        def loss_ref(q, k, v, heads):
+            out = multihead_attention(q, k, v, heads)[0]
+            return jnp.sum(out * jnp.cos(out))
+
+        g_xy = jax.grad(loss_pl, argnums=(0, 1, 2))(grid, lat, latv, 2, 512)
+        g_xy_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(grid, lat, latv, 2)
+        g_yx = jax.grad(loss_pl, argnums=(0, 1, 2))(lat, grid, gridv, 2, 16)
+        g_yx_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(lat, grid, gridv, 2)
+        b_xy = max(float(jnp.max(jnp.abs(a - b)))
+                   for a, b in zip(g_xy, g_xy_ref))
+        b_yx = max(float(jnp.max(jnp.abs(a - b)))
+                   for a, b in zip(g_yx, g_yx_ref))
+        ok = max(d_xy, d_yx, b_xy, b_yx) < atol
+        detail = (f"max_abs_diff fwd grid_to_latent={d_xy:.2e} "
+                  f"latent_to_grid={d_yx:.2e}; bwd grid_to_latent="
+                  f"{b_xy:.2e} latent_to_grid={b_yx:.2e} (atol {atol:g})")
     except Exception as e:  # Mosaic compile failures surface as many types
         ok = False
         detail = f"native compile/run failed: {type(e).__name__}: {e}"[:400]
@@ -282,10 +736,12 @@ def tpu_smoke_check(atol: float = 1e-2) -> tuple:
 def resolve_backend(requested: str) -> str:
     """'pallas' → 'pallas' only if safe on this backend, else 'xla'.
 
-    On CPU/GPU the pallas path runs in interpret mode (oracle-tested in CI);
-    on TPU the first resolution runs the native smoke check and falls back
-    to xla — with the reason printed — rather than advertising a kernel that
-    never compiled on the device class it exists for.
+    On CPU/GPU the pallas path runs in interpret mode (oracle-tested in CI,
+    forward AND backward); on TPU the first resolution runs the native
+    smoke check — which now compiles the backward kernels too, since the
+    training step programs dispatch them — and falls back to xla with the
+    reason printed, rather than advertising a kernel that never compiled
+    on the device class it exists for.
     """
     if requested != "pallas":
         return requested

@@ -24,14 +24,51 @@ def flops_of(compiled) -> Optional[float]:
     numbers pair directly with per-chip phase times for MFU (no further
     division by device count).  Returns None when the backend reports no
     usable figure."""
+    f = _cost_metric(compiled, "flops")
+    return f if f else None
+
+
+def _cost_metric(compiled, key: str) -> Optional[float]:
+    """ONE metric from ``cost_analysis()`` (list-wrapped on some
+    backends), or None when the backend reports no usable figure — the
+    single extraction every cost reader goes through."""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
-        f = float(ca.get("flops", 0.0))
-        return f if f > 0 else None
+        v = float(ca.get(key, 0.0))
+        return v if v > 0 else None
     except Exception:
         return None
+
+
+def bytes_accessed_of(compiled) -> Optional[float]:
+    """PER-DEVICE bytes accessed from XLA cost analysis (raw, like
+    ``flops_of``), or None when the backend reports no usable figure."""
+    return _cost_metric(compiled, "bytes accessed")
+
+
+def cost_summary(compiled) -> Dict[str, Optional[float]]:
+    """``{'gflops', 'gbytes'}`` from XLA cost analysis (None when the
+    backend reports no usable figure) — the shared extraction for the
+    satellite benches' JSON lines (``flops_of`` stays the raw-FLOPs API
+    the MFU math uses)."""
+    fl = _cost_metric(compiled, "flops")
+    by = _cost_metric(compiled, "bytes accessed")
+    return {"gflops": round(fl / 1e9, 3) if fl else None,
+            "gbytes": round(by / 1e9, 4) if by else None}
+
+
+def temp_workspace_gbytes(compiled) -> Optional[float]:
+    """Temp-workspace GB from ``memory_analysis()`` (None when absent) —
+    the §2 readiness quantity, shared by the satellite benches."""
+    try:
+        ma = compiled.memory_analysis()
+        v = float(getattr(ma, "temp_size_in_bytes", 0.0))
+        return round(v / 1e9, 4) if v > 0 else None
+    except Exception:
+        return None
+
 
 # bf16 peak TFLOP/s per chip by device_kind substring (public TPU specs).
 # Order matters: 'v5 lite' must win over 'v5'.
@@ -147,6 +184,37 @@ def find_suspects(
                 f"{name}: device_get sync tail {tail:.2f}s after a "
                 f"{loop_total:.2f}s timed loop — block_until_ready "
                 f"returned before the device finished (early acks)")
+    return out
+
+
+def single_timer_suspects(
+    name: str,
+    per_it_s: float,
+    tail_s: float,
+    iters: int,
+    per_it_2n_s: Optional[float] = None,
+    linearity_band: Tuple[float, float] = (0.7, 1.5),
+) -> List[str]:
+    """``find_suspects``'s early-ack defenses for ONE timed program (no
+    phase structure): the satellite benches (bench_pallas_attention)
+    route their loops through ``bench.steady_state_time`` and this check
+    so their numbers inherit the r3-retraction discipline.  Empty list =
+    no objection."""
+    out: List[str] = []
+    loop_total = per_it_s * iters
+    if tail_s > 0.3 * loop_total + 1.0:
+        out.append(
+            f"{name}: device_get sync tail {tail_s:.2f}s after a "
+            f"{loop_total:.2f}s timed loop — block_until_ready returned "
+            f"before the device finished (early acks)")
+    if per_it_2n_s is not None and per_it_s > 0:
+        ratio = per_it_2n_s / per_it_s
+        lo, hi = linearity_band
+        if not (lo <= ratio <= hi):
+            out.append(
+                f"linearity({name}): per-it time at 2N iters is "
+                f"{ratio:.2f}x the N-iter time (expect ~1.0) — wall "
+                f"clock not proportional to work done")
     return out
 
 

@@ -122,9 +122,21 @@ def default_stages():
         stage("bench_sweep", 1800, "bench_sweep_tpu.json", [py, "bench.py"],
               env={"GRAFT_BENCH_TPU_TIMEOUT": "1500",
                    "GRAFT_BENCH_SWEEP": "16,32"}),
-        # 8. Native-kernel record (Mosaic compile + parity).
+        # 8. Native-kernel record (Mosaic compile + parity) — now also
+        #    times grad and the R1/PL-shaped grad-of-grad per direction
+        #    and records the compiled-program byte evidence (ISSUE 9).
         stage("pallas", 600, "pallas_tpu.json",
               [py, "scripts/bench_pallas_attention.py"]),
+        # 8b. Training-path kernel A/B (ISSUE 9): the four REAL step
+        #    programs compiled at attention_backend xla vs pallas —
+        #    cost-analysis FLOPs/bytes/temp-workspace deltas plus
+        #    steady-state ms per phase, unattended.  The compiles are
+        #    the cost (8 second-order-ish programs, warm via the
+        #    persistent cache after the first window); timing itself is
+        #    10 iters/phase.
+        stage("pallas_train_ab", 1500, "pallas_train_ab_tpu.jsonl",
+              [py, "scripts/bench_pallas_attention.py", "--train-ab",
+               "--batch", "8"]),
         # 9. Real loop on the chip; stats.jsonl carries timing/mfu.
         #    --device-time-ticks 0: the periodic device-truth sampler is
         #    OFF for this unattended stage — a client killed mid-trace

@@ -127,6 +127,12 @@ def main(argv=None) -> int:
     p.add_argument("--assumed-mfu", type=float, default=ASSUMED_MFU)
     p.add_argument("--peak-tflops", type=float, default=None,
                    help="projection peak off-TPU (default: v5e 197)")
+    p.add_argument("--attention-backend", default="xla",
+                   choices=("xla", "pallas"),
+                   help="attention compute backend for the attn_block_*/"
+                        "attn_einsums_* components (ISSUE 9): re-rank the "
+                        "attribution table under the fused differentiable "
+                        "kernels (off-TPU they run in interpret mode)")
     args = p.parse_args(argv)
 
     import jax
@@ -148,7 +154,8 @@ def main(argv=None) -> int:
     from gansformer_tpu.ops.modulated_conv import (
         _conv, conv2d, modulated_conv2d)
     from gansformer_tpu.ops.upfirdn2d import downsample_2d, upsample_2d
-    from gansformer_tpu.utils.benchcheck import flops_of, peak_tflops
+    from gansformer_tpu.utils.benchcheck import (bytes_accessed_of, flops_of,
+                                                 peak_tflops)
 
     full_cfg = get_preset(args.preset)
     cfg = full_cfg.model
@@ -165,18 +172,12 @@ def main(argv=None) -> int:
     meta = {"device_kind": dev.device_kind, "platform": dev.platform,
             "batch": b, "preset": args.preset, "peak_bf16_tflops": peak,
             "projection_peak_tflops": proj_peak,
-            "assumed_mfu": args.assumed_mfu}
+            "assumed_mfu": args.assumed_mfu,
+            "attention_backend": args.attention_backend}
     print(json.dumps(meta), flush=True)
 
     def bytes_of(compiled):
-        try:
-            ca = compiled.cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0]
-            v = float(ca.get("bytes accessed", 0.0))
-            return v if v > 0 else None
-        except Exception:
-            return None
+        return bytes_accessed_of(compiled)
 
     def timed(name: str, fn, *xs, **extra_info):
         """Compile fn(*xs), time it (TPU only), emit one JSON line,
@@ -276,19 +277,28 @@ def main(argv=None) -> int:
             grid_dim=nf, latent_dim=cfg.w_dim, num_heads=cfg.num_heads,
             duplex=(cfg.attention == "duplex"), integration=cfg.integration,
             kmeans_iters=cfg.kmeans_iters, pos_encoding=cfg.pos_encoding,
-            fused_kv=cfg.attn_fused_kv, dtype=dtype)
+            fused_kv=cfg.attn_fused_kv, backend=args.attention_backend,
+            dtype=dtype)
         av = jax.jit(attn.init)(jax.random.fold_in(key, res), xg, yl)
         timed(f"attn_block_{res}",
               lambda v, x, y: attn.apply(v, x, y)[0], av, xg, yl,
-              res=res, n=res * res, k=cfg.components)
+              res=res, n=res * res, k=cfg.components,
+              attention_backend=args.attention_backend)
         q = jnp.asarray(rs.randn(b, res * res, nf), jnp.float32)
         kv_len = cfg.components + (1 if cfg.use_global else 0)
         kk = jnp.asarray(rs.randn(b, kv_len, nf), jnp.float32)
         vv = jnp.asarray(rs.randn(b, kv_len, nf), jnp.float32)
-        timed(f"attn_einsums_{res}",
-              lambda q, k, v: multihead_attention(q, k, v,
-                                                  cfg.num_heads)[0],
-              q, kk, vv, res=res, n=res * res, k=kv_len)
+        if args.attention_backend == "pallas":
+            from gansformer_tpu.ops.pallas_attention import (
+                multihead_attention_pallas)
+            einsums = lambda q, k, v: multihead_attention_pallas(
+                q, k, v, cfg.num_heads, interpret=not on_tpu)
+        else:
+            einsums = lambda q, k, v: multihead_attention(
+                q, k, v, cfg.num_heads)[0]
+        timed(f"attn_einsums_{res}", einsums,
+              q, kk, vv, res=res, n=res * res, k=kv_len,
+              attention_backend=args.attention_backend)
 
     # ---- model-level programs ----------------------------------------
     G, D = Generator(cfg), Discriminator(cfg)

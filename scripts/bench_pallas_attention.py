@@ -1,11 +1,35 @@
-"""Micro-bench: Pallas fused attention kernels vs the jnp/XLA composite.
+"""Micro-bench: Pallas fused attention kernels vs the jnp/XLA composite —
+forward, first-order grad, and an R1/PL-shaped grad-of-grad per direction
+(ISSUE 9: the kernels are differentiable and wired into the training
+path, so the A/B must price what training actually dispatches).
 
 Shapes are the flagship ffhq256-duplex attention workload (PERF.md §1):
-grid side n = H·W at the attended resolutions, k = 16 latents, C = nf(res).
-Run on the TPU chip (ambient backend); prints one JSON line per shape with
-both timings so PERF.md §1c can cite measured numbers.
+grid side n = H·W at the attended resolutions, k = 16 latents,
+C = nf(res).  Run on the TPU chip (ambient backend); prints one JSON line
+per (resolution, direction) with timings, cost-analysis FLOPs/bytes for
+the forward and grad programs of BOTH backends, and the byte deltas —
+the compiled-program evidence that the kernels remove the
+probability-map round-trip.  Off-TPU the pallas path runs in interpret
+mode: parity (max_abs_diff) and the xla-side cost analysis are still
+real, timings are skipped (bench_components.py discipline) and the
+pallas-side byte figures are labeled interpret-mode (the interpreter's
+emulation loop inflates them; only native Mosaic numbers count as
+traffic evidence).
+
+Timing rides ``bench.steady_state_time`` — the SAME validated loop as
+the phase bench — plus a 2× linearity re-time, so these numbers inherit
+the r3-retraction early-ack defenses (``benchcheck.single_timer_
+suspects``; a failed check lands in the line's ``suspect`` field instead
+of being presented clean).
 
   python scripts/bench_pallas_attention.py [--iters 50] [--res 32 64 128]
+  python scripts/bench_pallas_attention.py --train-ab [--preset ...]
+
+``--train-ab`` is the training-path A/B (battery stage
+``pallas_train_ab``): the four REAL step programs (d, g, d_r1, g_pl) are
+AOT-compiled per backend via ``benchcheck.lower_phase`` and their
+cost-analysis FLOPs / bytes / temp workspace recorded side by side (on
+TPU also steady-state timed), one JSON line per phase.
 """
 
 from __future__ import annotations
@@ -14,9 +38,23 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timed(fn, args, iters, name, on_tpu):
+    """(ms, suspects) via the shared validated steady-state loop + the 2×
+    linearity re-time; None off-TPU (timings there are meaningless)."""
+    if not on_tpu:
+        return None, []
+    from bench import steady_state_time
+    from gansformer_tpu.utils.benchcheck import single_timer_suspects
+
+    step = lambda carry: (carry, fn(*args))
+    _, per_it, tail = steady_state_time(step, None, iters)
+    _, per_it_2n, _ = steady_state_time(step, None, 2 * iters)
+    sus = single_timer_suspects(name, per_it, tail, iters, per_it_2n)
+    return round(per_it * 1e3, 3), sus
 
 
 def bench_one(res: int, k: int, batch: int, heads: int, iters: int,
@@ -28,6 +66,7 @@ def bench_one(res: int, k: int, batch: int, heads: int, iters: int,
     from gansformer_tpu.core.config import get_preset
     from gansformer_tpu.ops.attention import multihead_attention
     from gansformer_tpu.ops.pallas_attention import multihead_attention_pallas
+    from gansformer_tpu.utils.benchcheck import cost_summary
 
     cfg = get_preset("ffhq256-duplex").model
     c = cfg.nf(res)
@@ -45,36 +84,171 @@ def bench_one(res: int, k: int, batch: int, heads: int, iters: int,
         q = jnp.asarray(rs.randn(batch, k, c), dtype)
         kk = jnp.asarray(rs.randn(batch, n, c), dtype)
         v = jnp.asarray(rs.randn(batch, n, c), dtype)
-    interpret = jax.default_backend() != "tpu"
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = not on_tpu
 
-    fns = {
-        "xla": jax.jit(lambda q, kk, v: multihead_attention(q, kk, v, heads)[0]),
-    }
+    fwd = {"xla": lambda q, kk, v: multihead_attention(q, kk, v, heads)[0]}
     if pallas_ok:
-        fns["pallas"] = jax.jit(lambda q, kk, v: multihead_attention_pallas(
-            q, kk, v, heads, interpret=interpret))
+        fwd["pallas"] = lambda q, kk, v: multihead_attention_pallas(
+            q, kk, v, heads, interpret=interpret)
+
+    def grad_fn(f):
+        # first-order training shape: dq/dk/dv of a scalar loss
+        return jax.grad(
+            lambda q, kk, v: jnp.sum(f(q, kk, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))
+
+    def gg_fn(f):
+        # R1/PL-shaped grad-of-grad: outer grad w.r.t. the k/v side
+        # (the params side in the real programs) of the squared norm of
+        # the inner input-grad — the transform g_step_pl/d_step_r1 run.
+        def inner_sq(q, kk, v):
+            gq = jax.grad(lambda q: jnp.sum(f(q, kk, v)))(q)
+            return jnp.sum(gq.astype(jnp.float32) ** 2)
+
+        return jax.grad(inner_sq, argnums=(1, 2))
+
     out = {"direction": direction, "res": res, "n": n, "c": c, "k": k,
-           "batch": batch, "backend": jax.default_backend()}
-    ref = None
-    for name, fn in fns.items():
-        r = fn(q, kk, v)
-        jax.block_until_ready(r)
-        if ref is None:
-            ref = r
-        else:
-            err = float(jnp.max(jnp.abs(ref.astype(jnp.float32)
-                                        - r.astype(jnp.float32))))
-            out["max_abs_diff"] = err
-        t0 = time.time()
-        for _ in range(iters):
-            r = fn(q, kk, v)
-        jax.block_until_ready(r)
-        out[f"{name}_ms"] = round((time.time() - t0) / iters * 1e3, 3)
+           "batch": batch, "backend": jax.default_backend(),
+           "interpret_mode": interpret}
+    suspects: list = []
+    ref = {}
+    for name, f in fwd.items():
+        jf = jax.jit(f)
+        jg = jax.jit(grad_fn(f))
+        jgg = jax.jit(gg_fn(f))
+        for tag, jitted, args in (("", jf, (q, kk, v)),
+                                  ("grad_", jg, (q, kk, v)),
+                                  ("gg_", jgg, (q, kk, v))):
+            compiled = jitted.lower(*args).compile()
+            cost = cost_summary(compiled)
+            out[f"{name}_{tag}gflops"] = cost["gflops"]
+            out[f"{name}_{tag}gbytes"] = cost["gbytes"]
+            r = compiled(*args)
+            jax.block_until_ready(r)
+            if name == "xla":
+                ref[tag] = r
+            elif tag in ref:
+                flat = jax.tree_util.tree_leaves((ref[tag], r))
+                half = len(flat) // 2
+                err = max(float(jnp.max(jnp.abs(
+                    a.astype(jnp.float32) - b.astype(jnp.float32))))
+                    for a, b in zip(flat[:half], flat[half:]))
+                out[f"{tag}max_abs_diff"] = round(err, 6)
+            ms, sus = _timed(compiled, args, iters,
+                             f"{direction}/{name}_{tag or 'fwd'}", on_tpu)
+            if ms is not None:
+                out[f"{name}_{tag}ms"] = ms
+            suspects += sus
     if pallas_ok:
-        out["speedup"] = round(out["xla_ms"] / out["pallas_ms"], 3)
+        for tag in ("", "grad_", "gg_"):
+            a, b = out.get(f"xla_{tag}ms"), out.get(f"pallas_{tag}ms")
+            if a and b:
+                out[f"{tag}speedup"] = round(a / b, 3)
+            xb, pb = out.get(f"xla_{tag}gbytes"), out.get(f"pallas_{tag}gbytes")
+            if xb and pb:
+                # The probability-map round-trip evidence (ISSUE 9
+                # acceptance): meaningful under native Mosaic lowering
+                # only — the interpreter's emulation loop inflates the
+                # pallas side, so off-TPU this delta is labeled, not
+                # claimed.
+                out[f"{tag}gbytes_delta_vs_xla"] = round(pb - xb, 4)
+        if interpret:
+            out["bytes_note"] = ("interpret mode: pallas gbytes measure "
+                                 "the emulation loop, not HBM traffic — "
+                                 "native evidence comes from a TPU window")
     else:
         out["pallas_skipped"] = "native smoke check failed (see head line)"
+    if suspects:
+        out["suspect"] = suspects
     return out
+
+
+def train_ab(preset: str, batch: int, iters: int,
+             pallas_ok: bool = True) -> None:
+    """The training-path A/B: cost-analysis (and, on TPU, steady-state
+    time) of the four REAL step programs per attention backend — the
+    attention-bearing step programs' byte evidence, one JSON line per
+    phase (battery stage ``pallas_train_ab``).
+
+    Capture beats verdict: one line is FLUSHED per phase as soon as its
+    backends are measured, a failed smoke check skips the pallas side
+    (``pallas_skipped``, xla rows still land), and an unexpected
+    pallas-side compile/run failure is recorded as ``pallas_error`` on
+    the line instead of crashing the battery stage with the xla minutes
+    already spent."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from gansformer_tpu.core.config import get_preset
+    from gansformer_tpu.utils.benchcheck import (
+        cost_summary, lower_phase, temp_workspace_gbytes)
+
+    on_tpu = jax.default_backend() == "tpu"
+    base = get_preset(preset)
+    backends = ("xla", "pallas") if pallas_ok else ("xla",)
+
+    def measure(backend, phase):
+        cfg = dataclasses.replace(base, model=dataclasses.replace(
+            base.model, attention_backend=backend))
+        compiled = lower_phase(cfg, phase, batch_size=batch)
+        rec = {**cost_summary(compiled),
+               "temp_gbytes": temp_workspace_gbytes(compiled)}
+        if on_tpu:
+            from bench import steady_state_time
+            from gansformer_tpu.train.state import create_train_state
+            from gansformer_tpu.utils.benchcheck import \
+                single_timer_suspects
+
+            state = jax.jit(lambda k: create_train_state(cfg, k))(
+                jax.random.PRNGKey(0))
+            imgs = jax.device_put(np.random.RandomState(0).randint(
+                0, 255, (batch, cfg.model.resolution,
+                         cfg.model.resolution, 3), dtype=np.uint8))
+            rng = jax.random.PRNGKey(1)
+            extra = ((imgs, rng, None) if phase.startswith("d")
+                     else (rng, None))
+            state, _ = compiled(state, *extra)   # warm-up + donation
+            state, per_it, tail = steady_state_time(
+                lambda carry: compiled(carry, *extra), state, iters)
+            # 2× linearity re-time — the same early-ack defense pair as
+            # bench_one's _timed, so the docstring's "all numbers inherit
+            # the r3-retraction discipline" holds for the A/B rows too.
+            state, per_it_2n, _ = steady_state_time(
+                lambda carry: compiled(carry, *extra), state, 2 * iters)
+            rec["ms"] = round(per_it * 1e3, 3)
+            sus = single_timer_suspects(
+                f"{backend}/{phase}", per_it, tail, iters, per_it_2n)
+            if sus:
+                rec["suspect"] = sus
+        return rec
+
+    for phase in ("d", "g", "d_r1", "g_pl"):
+        line = {"name": f"train_ab_{phase}", "preset": preset,
+                "batch": batch, "platform": jax.default_backend()}
+        for backend in backends:
+            try:
+                rec = measure(backend, phase)
+            except Exception as e:   # Mosaic failures surface as many types
+                if backend == "xla":
+                    raise        # the baseline failing is a real stage error
+                line["pallas_error"] = (
+                    f"{type(e).__name__}: {e}"[:400])
+                continue
+            for key, val in rec.items():
+                line[f"{backend}_{key}"] = val
+        if not pallas_ok:
+            line["pallas_skipped"] = "native smoke check failed (see head line)"
+        xb, pb = line.get("xla_gbytes"), line.get("pallas_gbytes")
+        if xb and pb:
+            line["gbytes_delta_vs_xla"] = round(pb - xb, 4)
+        if not on_tpu:
+            line["bytes_note"] = ("interpret mode inflates the pallas "
+                                  "side; native deltas come from a TPU "
+                                  "window")
+        print(json.dumps(line), flush=True)
 
 
 def main() -> None:
@@ -84,6 +258,11 @@ def main() -> None:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--k", type=int, default=16)
     p.add_argument("--heads", type=int, default=1)
+    p.add_argument("--train-ab", action="store_true",
+                   help="A/B the four REAL step programs (xla vs pallas "
+                        "attention backend): cost-analysis bytes/FLOPs/"
+                        "temp workspace, plus steady-state ms on TPU")
+    p.add_argument("--preset", default="ffhq256-duplex")
     args = p.parse_args()
 
     import jax
@@ -93,9 +272,10 @@ def main() -> None:
     enable_compile_cache()
 
     # First line: the native-Mosaic reality record (VERDICT r4 item 4).
-    # On a TPU this compiles BOTH kernels natively at the gate's shapes and
-    # reports max_abs_diff vs the jnp oracle — the recorded artifact the
-    # runtime ``resolve_backend`` gate otherwise only produces transiently.
+    # On a TPU this compiles the kernels natively at the gate's shapes —
+    # now INCLUDING the backward kernels (the training path, ISSUE 9) —
+    # and reports max_abs_diff vs the jnp oracle: the recorded artifact
+    # the runtime ``resolve_backend`` gate otherwise produces transiently.
     dev = jax.devices()[0]
     head = {"device_kind": dev.device_kind, "platform": dev.platform}
     pallas_ok = True
@@ -110,8 +290,14 @@ def main() -> None:
         pallas_ok = ok
     else:
         head["note"] = ("non-TPU backend: pallas runs in interpret mode; "
-                        "no native Mosaic evidence from this run")
+                        "parity + xla cost analysis only — no native "
+                        "Mosaic evidence from this run")
     print(json.dumps(head), flush=True)
+
+    if args.train_ab:
+        train_ab(args.preset, args.batch, min(args.iters, 10),
+                 pallas_ok=pallas_ok)
+        return
 
     for res in args.res:
         for direction in ("grid_to_latent", "latent_to_grid"):

@@ -325,10 +325,14 @@ def test_config_validate_messages():
                  "pl_batch_shrink", "mesh.data", "mbstd_group_size"):
         assert frag in msg, msg
 
-    # pallas backend is forward-only — training configs must reject it
-    with pytest.raises(ValueError, match="forward-only"):
+    # pallas is training-grade since ISSUE 9 (backward kernels + second-
+    # order rule) — training configs must ACCEPT it; only unknown
+    # backends are rejected, with both valid names in the message
+    ExperimentConfig(model=ModelConfig(
+        attention_backend="pallas")).validate()
+    with pytest.raises(ValueError, match="xla|pallas"):
         ExperimentConfig(model=ModelConfig(
-            attention_backend="pallas")).validate()
+            attention_backend="mosaic")).validate()
 
     # sequence-parallel / mesh.model consistency both ways
     with pytest.raises(ValueError, match="sequence_parallel"):
@@ -336,9 +340,45 @@ def test_config_validate_messages():
     with pytest.raises(ValueError, match="mesh.model"):
         ExperimentConfig(model=ModelConfig(sequence_parallel=True)).validate()
 
+    # pallas has no sharded kernel path: combined with sequence_parallel
+    # the opaque pallas_call would make GSPMD all-gather the full n axis
+    # per device — reject instead of silently un-sharding
+    with pytest.raises(ValueError, match="sequence-parallel"):
+        ExperimentConfig(
+            model=ModelConfig(attention_backend="pallas",
+                              sequence_parallel=True),
+            mesh=MeshConfig(model=2)).validate()
+
     # every shipped preset is valid
     for name, preset in PRESETS.items():
         preset.validate()
+
+
+def test_train_cli_attention_backend_tristate(tmp_path):
+    """--attention-backend on the TRAIN CLI (ISSUE 9): tri-state like the
+    other model flags — None inherits the loaded config (a resumed pallas
+    run keeps its backend), an explicit flag overrides it, and the value
+    passes the relaxed validate() rule."""
+    from gansformer_tpu.core.config import ModelConfig
+
+    saved = ExperimentConfig(model=ModelConfig(attention_backend="pallas"))
+    path = tmp_path / "config.json"
+    path.write_text(saved.to_json())
+
+    args = build_parser().parse_args(["--config", str(path)])
+    assert config_from_args(args).model.attention_backend == "pallas"
+
+    args = build_parser().parse_args(
+        ["--config", str(path), "--attention-backend", "xla"])
+    assert config_from_args(args).model.attention_backend == "xla"
+
+    args = build_parser().parse_args(["--attention-backend", "pallas"])
+    cfg = config_from_args(args)       # validate() runs inside
+    assert cfg.model.attention_backend == "pallas"
+
+    # unknown values are an argparse error (matching the config rule)
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--attention-backend", "mosaic"])
 
 
 def test_resume_inherits_mesh_layout(tmp_path):

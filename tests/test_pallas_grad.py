@@ -1,0 +1,281 @@
+"""ISSUE 9 acceptance: the Pallas bipartite-attention kernels are
+differentiable — to second order — and training-grade.
+
+Interpret-mode parity on CPU against the jnp oracle
+(``ops.attention.multihead_attention``) for both directions: forward,
+first-order grads (dq/dk/dv), and R1/PL-shaped double-backwards, in f32
+and bf16; plus the wiring contracts (bwd kernels actually on the reverse
+path, forward-mode rejection, generator-level grad parity) and the
+training-path parity of all four step programs under
+``attention_backend='pallas'``.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gansformer_tpu import ops
+from gansformer_tpu.ops.pallas_attention import (
+    grid_to_latent_attention,
+    latent_to_grid_attention,
+    multihead_attention_pallas,
+)
+
+# (batch, Lq, Lk, D, Dv, block_n): covers both directions, the padded
+# n-block tail (g2l) and the masked flash tail (l2g — n=100 over
+# block_n=32 is already multi-block with a masked tail).  The "-odd"
+# member (a second non-divisible-n geometry) rides the slow sweep:
+# interpret-mode grad traces cost seconds per case and the main l2g
+# member already exercises the mask in tier-1.
+CASES = {
+    "grid_to_latent": (2, 100, 9, 16, 24, 32),
+    "latent_to_grid": (2, 9, 100, 16, 24, 32),
+    "latent_to_grid-odd": (1, 5, 257, 8, 8, 64),
+}
+ODD_SLOW = [
+    "grid_to_latent", "latent_to_grid",
+    pytest.param("latent_to_grid-odd", marks=pytest.mark.slow),
+]
+
+
+def _inputs(rng, case, dtype=jnp.float32):
+    b, lq, lk, d, dv, bn = CASES[case]
+    q = jnp.asarray(rng.randn(b, lq, d), dtype)
+    k = jnp.asarray(rng.randn(b, lk, d), dtype)
+    v = jnp.asarray(rng.randn(b, lk, dv), dtype)
+    fn = (grid_to_latent_attention if lq >= lk else latent_to_grid_attention)
+    att = lambda q, k, v: fn(q, k, v, block_n=bn, interpret=True)
+    oracle = lambda q, k, v: ops.multihead_attention(q, k, v, 1)[0]
+    return q, k, v, att, oracle
+
+
+@pytest.mark.parametrize("case", ODD_SLOW)
+def test_first_order_grads_match_oracle(rng, case):
+    """dq/dk/dv from the backward kernels vs the differentiated jnp
+    composite (f32, per-dtype tolerance)."""
+    q, k, v, att, oracle = _inputs(rng, case)
+
+    def loss(f):
+        def fn(q, k, v):
+            o = f(q, k, v)     # nonlinear in o, so dL/do varies per row
+            return jnp.sum(o * jnp.cos(o))
+        return fn
+
+    got = jax.grad(loss(att), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss(oracle), argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "dq dk dv".split()):
+        assert g.dtype == w.dtype, name
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=5e-4, rtol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("case", ["grid_to_latent", "latent_to_grid"])
+def test_first_order_grads_bf16(rng, case):
+    """bf16 in/out: cotangents keep the primal dtypes and stay within
+    bf16 round-off of the oracle (stats are fp32 in both paths)."""
+    q, k, v, att, oracle = _inputs(rng, case, jnp.bfloat16)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
+
+    got = jax.grad(loss(att), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss(oracle), argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "dq dk dv".split()):
+        assert g.dtype == jnp.bfloat16, name
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w, np.float32),
+            atol=0.2, rtol=0.1, err_msg=name)
+
+
+@pytest.mark.parametrize("case", ODD_SLOW)
+def test_r1_shaped_double_backward(rng, case):
+    """The R1 transform shape (losses/gan.py r1_penalty): grad w.r.t. a
+    parameter of ‖grad w.r.t. the INPUT‖² — reverse-over-reverse through
+    the kernels must match the oracle."""
+    q, k, v, att, oracle = _inputs(rng, case)
+
+    def r1(w, f):
+        gq = jax.grad(lambda q: jnp.sum(f(q * w, k, v)))(q)
+        return jnp.sum(gq ** 2)
+
+    got = jax.grad(lambda w: r1(w, att))(1.1)
+    want = jax.grad(lambda w: r1(w, oracle))(1.1)
+    np.testing.assert_allclose(float(got), float(want), atol=1e-3,
+                               rtol=1e-3)
+
+
+@pytest.mark.slow  # the R1 sweep above is the tier-1 second-order gate
+@pytest.mark.parametrize("case", ["grid_to_latent", "latent_to_grid"])
+def test_pl_shaped_hvp(rng, case):
+    """The PL transform shape (losses/gan.py path_length_penalty): the
+    params scale the k/v projections and the HVP flows through the inner
+    input-grad — jitted, like the real g_step_pl program."""
+    q, k, v, att, oracle = _inputs(rng, case)
+
+    def pl(w, f):
+        gq = jax.grad(lambda q: jnp.sum(f(q, k * w, v * w)))(q)
+        return jnp.sum(gq ** 2)
+
+    got = jax.jit(jax.grad(lambda w: pl(w, att)))(0.9)
+    want = jax.grad(lambda w: pl(w, oracle))(0.9)
+    np.testing.assert_allclose(float(got), float(want), atol=1e-3,
+                               rtol=1e-3)
+
+
+def test_bwd_kernels_are_on_the_reverse_path(rng):
+    """The first-order reverse path must RUN the backward kernels, not a
+    transposed jnp tangent: the grad jaxpr carries ≥ 2 pallas_call sites
+    (forward-stats + backward), where a glue-transposed rule would carry
+    exactly the forward one."""
+    q, k, v, att, _ = _inputs(rng, "grid_to_latent")
+    jaxpr = str(jax.make_jaxpr(
+        jax.grad(lambda q: jnp.sum(att(q, k, v))))(q))
+    assert jaxpr.count("pallas_call") >= 2, jaxpr[:2000]
+
+
+def test_forward_mode_is_rejected(rng):
+    """Direct jax.jvp through the op is NOT supported (custom_vjp outer
+    layer) — pinned so a future jvp-based loss reformulation fails loudly
+    here instead of deep inside a trace.  R1/PL are reverse-mode
+    formulations (losses/gan.py) and never hit this."""
+    q, k, v, att, _ = _inputs(rng, "grid_to_latent")
+    with pytest.raises(TypeError, match="custom_vjp"):
+        jax.jvp(lambda q: att(q, k, v), (q,), (q,))
+
+
+@pytest.mark.slow  # ~26 s: whole-generator trace + interpret execution
+def test_generator_pallas_param_grads_match_xla(rng):
+    """End-to-end first-order check: grads of a duplex generator loss
+    w.r.t. EVERY parameter agree between the backends (head folding, both
+    kernel directions, flax integration).  Slow: the op-level parity
+    tests above are the tier-1 gate; this and the step-program tests
+    below are the (slow) integration layer over the same kernels."""
+    from gansformer_tpu.core.config import ModelConfig
+    from gansformer_tpu.models.generator import Generator
+
+    cfg = ModelConfig(resolution=16, components=2, latent_dim=16, w_dim=16,
+                      mapping_dim=16, mapping_layers=2, fmap_base=64,
+                      fmap_max=16, attention="duplex", attn_start_res=8,
+                      attn_max_res=8)
+    z = jnp.asarray(rng.randn(2, cfg.num_ws, cfg.latent_dim), jnp.float32)
+    noise = jax.random.PRNGKey(3)
+    G_xla = Generator(cfg)
+    params = G_xla.init({"params": jax.random.PRNGKey(0), "noise": noise}, z)
+    G_pl = Generator(dataclasses.replace(cfg, attention_backend="pallas"))
+
+    def loss(G):
+        return lambda p: jnp.mean(
+            G.apply(p, z, rngs={"noise": noise}) ** 2)
+
+    g_xla = jax.grad(loss(G_xla))(params)
+    g_pl = jax.grad(loss(G_pl))(params)
+    leaves_x = jax.tree_util.tree_leaves(g_xla)
+    leaves_p = jax.tree_util.tree_leaves(g_pl)
+    assert len(leaves_x) == len(leaves_p)
+    for x, p in zip(leaves_x, leaves_p):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(p),
+                                   atol=2e-5, rtol=2e-3)
+
+
+# --------------------------------------------------------------------------
+# Training path: all four step programs on the pallas backend
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def reg_step_pair():
+    """The second-order SUPERSET step programs (d_step_r1, g_step_pl —
+    each contains its plain sibling's whole graph plus the reg term) on
+    both backends, same inputs/rng — compiled once, shared by the
+    assertions below (slow-marked: ~25 s of second-order compiles).  The
+    full four-program cadence (d, g, d_r1, g_pl through real ticks)
+    rides the slow micro-train test; tracing all eight programs here
+    would double the bill for the two branches the supersets already
+    contain."""
+    from gansformer_tpu.parallel.mesh import make_mesh
+    from gansformer_tpu.train.state import create_train_state
+    from gansformer_tpu.train.steps import make_train_steps
+    from tests.test_train import micro_cfg
+
+    imgs_np = np.random.RandomState(0).randint(
+        0, 255, (8, 16, 16, 3), dtype=np.uint8)
+    rng = jax.random.PRNGKey(11)
+    out = {}
+    for backend in ("xla", "pallas"):
+        cfg = micro_cfg(attention="duplex")
+        cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+            cfg.model, attention_backend=backend))
+        cfg.validate()       # the relaxed rule: pallas is training-grade
+        env = make_mesh(cfg.mesh)
+        state = jax.device_put(create_train_state(cfg, jax.random.PRNGKey(0)),
+                               env.replicated())
+        fns = make_train_steps(cfg, env, batch_size=cfg.train.batch_size)
+        imgs = jax.device_put(imgs_np, env.batch())
+        with env.activate():
+            r = jax.random.fold_in(rng, 0)
+            state, d_aux = fns.d_step_r1(state, imgs,
+                                         jax.random.fold_in(r, 0))
+            state, g_aux = fns.g_step_pl(state, jax.random.fold_in(r, 1))
+            jax.block_until_ready(state.step)
+        out[backend] = {k: float(jax.device_get(v))
+                        for k, v in {**d_aux, **g_aux}.items()}
+    return out
+
+
+@pytest.mark.slow  # the fixture compiles 4 second-order programs (~25 s)
+def test_pallas_training_reg_steps_finite(reg_step_pair):
+    """The lifted core/config.py restriction, exercised: the REAL
+    second-order step programs (R1 grad-of-grad, PL HVP through
+    synthesis) compile and produce finite losses on the pallas backend."""
+    aux = reg_step_pair["pallas"]
+    assert "Loss/D/r1" in aux and "Loss/G/pl" in aux
+    for k, v in aux.items():
+        assert np.isfinite(v), (k, v)
+
+
+@pytest.mark.slow  # shares the reg_step_pair fixture
+def test_pallas_training_losses_match_xla(reg_step_pair):
+    """Losses of the second-order step programs agree across backends
+    within fp-reorder tolerance — the backend changes the attention
+    compute path, never the math."""
+    ax, ap = reg_step_pair["xla"], reg_step_pair["pallas"]
+    assert set(ax) == set(ap)
+    for k in ax:
+        np.testing.assert_allclose(ap[k], ax[k], atol=5e-3, rtol=5e-3,
+                                   err_msg=k)
+
+
+@pytest.mark.slow  # two micro train() runs (fresh second-order compiles)
+def test_micro_train_run_pallas_vs_xla(tmp_path):
+    """ISSUE 9 acceptance: a micro ``train()`` run with
+    ``attention_backend='pallas'`` (interpret mode on CPU) completes with
+    finite losses through full lazy-reg cadences, and its per-tick loss
+    means agree with the xla backend within tolerance (25 iterations of
+    chained updates amplify fp-reorder noise, hence the loose band)."""
+    import json
+    import os
+
+    from gansformer_tpu.train.loop import train
+    from tests.test_train import micro_cfg
+
+    ticks = {}
+    for backend in ("xla", "pallas"):
+        cfg = micro_cfg(attention="duplex", batch=40)
+        cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+            cfg.model, attention_backend=backend))
+        cfg.validate()
+        d = str(tmp_path / backend)
+        os.makedirs(d)
+        train(cfg, d)
+        with open(os.path.join(d, "stats.jsonl")) as f:
+            rows = [json.loads(line) for line in f]
+        assert rows, backend
+        ticks[backend] = rows[-1]
+    for key in ("Loss/D", "Loss/G", "Loss/D/r1", "Loss/G/pl",
+                "Loss/scores/real", "Loss/scores/fake"):
+        a, b = ticks["xla"][key], ticks["pallas"][key]
+        assert np.isfinite(a) and np.isfinite(b), (key, a, b)
+        np.testing.assert_allclose(b, a, atol=0.2, rtol=0.2, err_msg=key)

@@ -147,6 +147,55 @@ def test_g_step_all_reduces_on_two_device_mesh():
         "g_step compiled to zero all-reduces — replicated compute"
 
 
+def test_fast_matrix_has_pallas_backend_member():
+    """ISSUE 9 satellite: the traced-entry catalog carries the pallas
+    training backend (interpret mode off-TPU) via its second-order
+    superset programs, on the DUPLEX model so both kernel directions
+    (and both backward kernels) sit inside the traced jaxprs."""
+    from gansformer_tpu.analysis.trace.entry_points import (
+        build_matrix, trace_configs)
+
+    cfg = trace_configs()["tiny-pallas"]
+    assert cfg.model.attention_backend == "pallas"
+    assert cfg.model.attention == "duplex"
+    cfg.validate()          # the relaxed training rule covers the member
+    pallas_eps = [ep for ep in build_matrix("fast")
+                  if ep.config_name == "tiny-pallas"]
+    assert {ep.name.split(".")[1].split("[")[0] for ep in pallas_eps} \
+        == {"d_step_r1", "g_step_pl"}
+
+
+@pytest.mark.slow
+def test_pallas_backend_entries_graftcomms_clean():
+    """ISSUE 9 satellite: partition-contract + collective-flow stay CLEAN
+    (zero non-baselined findings, zero skip-notes) over the pallas-backend
+    member's second-order programs on the simulated 2-device mesh — the
+    kernels must not break the declared layouts or the gradient
+    all-reduce."""
+    from gansformer_tpu.analysis.trace.collective_flow import (
+        CollectiveFlowRule)
+    from gansformer_tpu.analysis.trace.entry_points import (
+        build_entry_points)
+    from gansformer_tpu.analysis.trace.harness import run_trace
+    from gansformer_tpu.analysis.trace.partition_contract import (
+        PartitionContractRule)
+
+    eps = build_entry_points("tiny-pallas",
+                             include=["d_step_r1", "g_step_pl"])
+    assert len(eps) == 2
+    findings, ctx = run_trace(
+        "fast", rules=[PartitionContractRule, CollectiveFlowRule],
+        entries=eps, mesh_sizes=(2,))
+    _assert_no_new(_apply_baseline(findings))
+    assert not ctx.notes, ctx.notes
+    assert {(r["entry"], r["devices"]) for r in ctx.comms} \
+        == {(ep.name, 2) for ep in eps}
+    # the gradient all-reduce survives the backend swap
+    for rec in ctx.comms:
+        assert rec["collectives"].get("all-reduce", {}).get("count", 0) \
+            >= 1, rec
+
+
 @pytest.mark.slow
 def test_g_step_per_device_flops_halve_on_two_device_mesh():
     """ISSUE 7 acceptance: at a FIXED global batch, the 2-device

@@ -269,6 +269,10 @@ def test_sequence_parallel_grid_sharding_parity():
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-3, err_msg=key)
 
 
+@pytest.mark.slow  # compiles the cycle (largest program in the repo) AND
+# the four unfused steps; the tier-1 870 s budget was killing this test
+# (and everything after it) mid-compile, so it runs in the slow tier where
+# it actually executes
 def test_fused_cycle_matches_unfused_loop():
     """TrainStepFns.cycle — one jitted program per full lazy-reg cycle —
     must follow the EXACT random stream and update sequence of the
@@ -340,6 +344,8 @@ def test_fused_cycle_matches_unfused_loop():
         assert np.max(np.abs(lu - lf)) <= 4 * lr + 1e-6
 
 
+@pytest.mark.slow  # same cycle-vs-loop compile pair as above, conditional
+# variant — see the slow rationale there
 def test_fused_cycle_conditional_labels():
     """The fused cycle's label path: label_k is indexed with TRACED
     iteration indices inside the scans — a conditional cycle must follow
