@@ -1,11 +1,18 @@
-"""hot-loop-sync: host syncs in the train loop's per-iteration body.
+"""hot-loop-sync: host syncs in a hot loop's per-iteration body.
 
 Migrated from ``scripts/check_hot_loop.py`` (PR 2), which is now a thin
 shim over this module.  The throughput discipline (PERF.md §1b) allows
-exactly ONE host sync in the hot loop: the tick-boundary fetch inside
-``with span("tick_fetch")``.  Any other ``block_until_ready`` /
-``device_get`` call in a ``while`` loop of a function named ``_train``
-reintroduces a serial host stall per iteration.
+exactly ONE host sync per hot loop: the sanctioned fetch span.  The
+repo has two such loops, each with its own span (``HOT_LOOPS``):
+
+* ``_train`` (train/loop.py) — the tick-boundary fetch inside
+  ``with span("tick_fetch")``;
+* ``_serve_dispatch`` (serve/service.py, ISSUE 10) — device fetches
+  inside ``with span("serve_fetch")``.
+
+Any other ``block_until_ready`` / ``device_get`` call in a ``while``
+loop of those functions reintroduces a serial host stall per iteration
+(per request batch, on the serving side).
 
 This rule complements host-sync-in-jit: the loop body is NOT a jit
 region (it's the host orchestrator), so the tracer-taint rule stays
@@ -29,6 +36,10 @@ from gansformer_tpu.analysis.engine import FileContext, Rule, register
 BANNED = {"block_until_ready", "device_get"}
 SANCTIONED_SPAN = "tick_fetch"
 
+# hot-loop function name -> its sanctioned fetch span
+HOT_LOOPS = {"_train": SANCTIONED_SPAN,
+             "_serve_dispatch": "serve_fetch"}
+
 _DEFAULT_TARGET = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))),
@@ -44,22 +55,25 @@ def _call_name(node: ast.Call) -> Optional[str]:
     return None
 
 
-def _is_sanctioned_with(node: ast.With) -> bool:
-    """``with span("tick_fetch")`` (possibly among other items)."""
+def _is_sanctioned_with(node: ast.With,
+                        span_name: str = SANCTIONED_SPAN) -> bool:
+    """``with span("<span_name>")`` (possibly among other items)."""
     for item in node.items:
         e = item.context_expr
         if isinstance(e, ast.Call) and _call_name(e) == "span" and \
                 e.args and isinstance(e.args[0], ast.Constant) and \
-                e.args[0].value == SANCTIONED_SPAN:
+                e.args[0].value == span_name:
             return True
     return False
 
 
-def _scan(node: ast.AST, sanctioned: bool, violations: List[dict]) -> None:
+def _scan(node: ast.AST, sanctioned: bool, violations: List[dict],
+          span_name: str = SANCTIONED_SPAN) -> None:
     """Recursive walk tracking whether we are under a sanctioned with."""
     for child in ast.iter_child_nodes(node):
         child_ok = sanctioned
-        if isinstance(child, ast.With) and _is_sanctioned_with(child):
+        if isinstance(child, ast.With) and \
+                _is_sanctioned_with(child, span_name):
             child_ok = True
         if isinstance(child, ast.Call):
             name = _call_name(child)
@@ -67,17 +81,17 @@ def _scan(node: ast.AST, sanctioned: bool, violations: List[dict]) -> None:
                 violations.append({"line": child.lineno,
                                    "col": child.col_offset,
                                    "call": name})
-        _scan(child, child_ok, violations)
+        _scan(child, child_ok, violations, span_name)
 
 
-def _scan_train(fn: ast.AST) -> List[dict]:
-    """Violations in every ``while`` loop of one ``_train`` def.
+def _scan_hot_fn(fn: ast.AST, span_name: str) -> List[dict]:
+    """Violations in every ``while`` loop of one hot-loop def.
     Scanning the While node covers its condition AND its body (a
     device_get in the while test would sync every iteration too)."""
     violations: List[dict] = []
     for sub in ast.walk(fn):
         if isinstance(sub, ast.While):
-            _scan(sub, False, violations)
+            _scan(sub, False, violations, span_name)
     return violations
 
 
@@ -85,19 +99,21 @@ def _scan_train(fn: ast.AST) -> List[dict]:
 class HotLoopSync(Rule):
     id = "hot-loop-sync"
     description = ("block_until_ready/device_get in the per-iteration "
-                   "while body of _train outside the sanctioned "
-                   "span(\"tick_fetch\") block")
-    hint = ("move the sync into the tick-boundary span(\"tick_fetch\") "
-            "block, or use copy_to_host_async (non-blocking)")
+                   "while body of a hot loop (_train, _serve_dispatch) "
+                   "outside its sanctioned fetch span")
+    hint = ("move the sync into the loop's sanctioned fetch span "
+            "(tick_fetch / serve_fetch), or use copy_to_host_async "
+            "(non-blocking)")
     node_types = (ast.FunctionDef, ast.AsyncFunctionDef)
 
     def check(self, node: ast.AST, ctx: FileContext) -> None:
-        if node.name != "_train":
+        span_name = HOT_LOOPS.get(node.name)
+        if span_name is None:
             return
-        for v in _scan_train(node):
+        for v in _scan_hot_fn(node, span_name):
             ctx.report(self, (v["line"], v["col"]),
                        f"{v['call']}() in the hot loop outside "
-                       f"span(\"{SANCTIONED_SPAN}\") — one host stall "
+                       f"span(\"{span_name}\") — one host stall "
                        f"per iteration")
 
 

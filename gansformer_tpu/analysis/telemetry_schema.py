@@ -150,6 +150,32 @@ def check_metric_families(path: str) -> List[str]:
     return errors
 
 
+def check_serve_metric_families(path: str) -> List[str]:
+    """Serving SLO families (ISSUE 10): a service's ``telemetry.prom``
+    must carry the queue-depth / batch-fill / latency histograms and
+    the dispatch counters — absence means the SLO wiring rotted, and a
+    load-test artifact without them is unreviewable.  Values-aware the
+    same way the device-truth check is: traffic served implies latency
+    samples landed."""
+    from gansformer_tpu.obs.registry import parse_prom_values
+
+    vals = parse_prom_values(path)
+    errors = []
+    for name in ("serve_queue_depth_count", "serve_batch_fill_count",
+                 "serve_e2e_ms_count", "serve_requests_total",
+                 "serve_images_total", "serve_map_dispatch_total",
+                 "serve_synth_dispatch_total",
+                 "serve_wcache_hits_total", "serve_wcache_misses_total"):
+        if name not in vals:
+            errors.append(f"{path}: missing serve/* family member "
+                          f"{name} (is the serving telemetry wired?)")
+    if vals.get("serve_requests_total", 0.0) > 0 and \
+            vals.get("serve_e2e_ms_count", 0.0) <= 0:
+        errors.append(f"{path}: requests were served but no "
+                      f"serve_e2e_ms latency samples landed")
+    return errors
+
+
 def check_heartbeat(path: str) -> List[str]:
     errors = []
     try:

@@ -26,6 +26,11 @@ trace stands in for the flagship config at a fraction of the cost.
                   changes the attention compute path, not the step
                   structure, so re-tracing the whole catalog would
                   double cost for no new coverage (ISSUE 9).
+
+The serving split (ISSUE 10) rides the same matrix via
+``build_serve_entry_points``: ``serve_map_seeds`` / ``serve_map_z`` /
+``serve_synth`` over the tiny reference config, contracts declared in
+``parallel/contracts.ENTRY_CONTRACTS`` like every train entry.
 """
 
 from __future__ import annotations
@@ -229,6 +234,83 @@ def build_entry_points(config_name: str,
     return eps
 
 
+def build_serve_entry_points(config_name: str = "tiny-f32",
+                             bucket: int = _BATCH,
+                             include: Optional[List[str]] = None
+                             ) -> List[EntryPoint]:
+    """EntryPoints for the serving split (serve/programs.py, ISSUE 10):
+    ``serve_map_seeds`` / ``serve_map_z`` / ``serve_synth`` over the
+    tiny trace config, so partition-contract / collective-flow gate the
+    REAL serving programs — replicated params, per-request rows on
+    ``data`` — not a proxy.  ``bucket`` is the traced batch bucket
+    (default: the matrix batch, divisible by every simulated data
+    axis)."""
+    import jax
+    import numpy as np
+
+    from gansformer_tpu.parallel.contracts import contract_for
+    from gansformer_tpu.serve.programs import generator_fns
+
+    cfg = trace_configs()[config_name]
+    m = cfg.model
+    fns = generator_fns(cfg)
+    params_abs = _abstract_state(cfg).ema_params
+    states = _StateFactory(cfg)
+    seeds_abs = jax.ShapeDtypeStruct((bucket,), np.int32)
+    z_abs = jax.ShapeDtypeStruct((bucket, m.num_ws, m.latent_dim),
+                                 np.float32)
+    ws_abs = jax.ShapeDtypeStruct((bucket, m.num_ws, m.w_dim), np.float32)
+    w_avg_abs = jax.ShapeDtypeStruct((m.w_dim,), np.float32)
+    psi_abs = jax.ShapeDtypeStruct((bucket,), np.float32)
+    key_abs = jax.ShapeDtypeStruct((2,), np.uint32)
+
+    def rand(seed, shape):
+        return np.random.RandomState(seed).normal(
+            size=shape).astype(np.float32)
+
+    table = {
+        "serve_map_seeds": (
+            fns.map_seeds, (params_abs, seeds_abs),
+            lambda: (states.fresh().ema_params,
+                     np.arange(1, bucket + 1, dtype=np.int32)),
+            ("state", "batch")),
+        "serve_map_z": (
+            fns.map_z, (params_abs, z_abs),
+            lambda: (states.fresh().ema_params, rand(20, z_abs.shape)),
+            ("state", "batch")),
+        "serve_synth": (
+            fns.synthesize,
+            (params_abs, w_avg_abs, ws_abs, psi_abs, key_abs),
+            lambda: (states.fresh().ema_params,
+                     np.zeros(w_avg_abs.shape, np.float32),
+                     rand(21, ws_abs.shape),
+                     np.full((bucket,), 0.7, np.float32),
+                     np.asarray(jax.random.PRNGKey(22))),
+            ("state", "repl", "batch", "batch", "repl")),
+    }
+    eps: List[EntryPoint] = []
+    for short, (fn, abstract_args, make_args, arg_specs) in table.items():
+        if include is not None and short not in include:
+            continue
+        if contract_for(short) is None:   # same loud gate as add()
+            raise ValueError(
+                f"serve entry point {short!r}: no sharding contract in "
+                f"parallel/contracts.ENTRY_CONTRACTS")
+        path, line = def_site(fn)
+        # keep_unused=True: the split programs each use a SUBSET of the
+        # params tree (map touches only the mapping network) and XLA
+        # would prune the rest from the compiled signature — the
+        # contract audit needs the resolved input shardings aligned
+        # 1:1 with the declared leaves
+        eps.append(EntryPoint(
+            name=f"serve.{short}[{config_name}]",
+            fn=jax.jit(fn, keep_unused=True),
+            abstract_args=abstract_args, make_args=make_args,
+            path=path, line=line, config_name=config_name,
+            compute_dtype=m.dtype, arg_specs=arg_specs))
+    return eps
+
+
 # The default trace surface per profile.  Structural rules only trace
 # (no compile), so ``fast`` keeps full entry coverage on the reference
 # config and targets the *added-value* members of the other two: bf16
@@ -254,8 +336,15 @@ def build_matrix(profile: str = "fast") -> List[EntryPoint]:
     if profile == "fast":
         for cname, include in FAST_MATRIX.items():
             out.extend(build_entry_points(cname, include=include))
+        # the serving split (ISSUE 10): map is the cache-feeding half,
+        # synth the per-request hot program — the pair the service
+        # dispatches; map_z (the generate-CLI flavor) differs from
+        # map_seeds only by the latent draw, so full keeps it alone
+        out.extend(build_serve_entry_points(
+            include=["serve_map_seeds", "serve_synth"]))
     else:
         for cname in trace_configs():
             out.extend(build_entry_points(cname,
                                           include=FULL_INCLUDE.get(cname)))
+        out.extend(build_serve_entry_points())
     return out

@@ -1,5 +1,13 @@
 """Generate CLI — reference ``src/generate.py`` (SURVEY.md §3.5): load a
-snapshot, sample images with truncation ψ, write PNG grids."""
+snapshot, sample images with truncation ψ, write PNG grids.
+
+Since ISSUE 10 this rides the serving path: a G-only partial restore
+(``serve.load_generator`` — the discriminator and both optimizer states
+are never initialized or read) and the split AOT programs
+(``serve.ServePrograms``: ``map_z`` + ψ-vectorized ``synthesize``,
+warm-started from the serialized-executable manifest).  A second
+invocation therefore compiles nothing — it deserializes.
+"""
 
 from __future__ import annotations
 
@@ -8,6 +16,16 @@ import os
 
 import jax
 import numpy as np
+
+
+def _pad_rows(a: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad a [n, ...] host batch to the compiled bucket by repeating the
+    last row (rows are independent, so the prefix stays bit-identical —
+    the padding-parity contract in tests/test_serve.py)."""
+    if a.shape[0] == bucket:
+        return a
+    pad = np.broadcast_to(a[-1:], (bucket - a.shape[0],) + a.shape[1:])
+    return np.concatenate([a, pad])
 
 
 def main(argv=None) -> None:
@@ -40,28 +58,27 @@ def main(argv=None) -> None:
                    help="also save the component-mixing grid: row sources "
                         "keep the leading latent components, column sources "
                         "supply the suffix (mix.png)")
+    p.add_argument("--no-warm-start", action="store_true",
+                   help="skip the serialized-executable manifest")
     args = p.parse_args(argv)
 
-    from gansformer_tpu.core.config import ExperimentConfig
-    from gansformer_tpu.train import checkpoint as ckpt
+    import dataclasses
+
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import (
+        ServePrograms, default_manifest_dir, load_generator)
     from gansformer_tpu.utils.hostenv import enable_compile_cache
-    from gansformer_tpu.train.state import create_train_state
-    from gansformer_tpu.train.steps import make_train_steps
     from gansformer_tpu.utils.image import save_image_grid, to_uint8
     from gansformer_tpu.utils.runarchive import resolve_run_dir
 
     args.run_dir = resolve_run_dir(args.run_dir)
     enable_compile_cache()
 
-    with open(os.path.join(args.run_dir, "config.json")) as f:
-        cfg = ExperimentConfig.from_json(f.read())
-    # Template init always runs the xla backend (param trees are identical);
-    # the backend override only touches the sampling step functions.
-    template = create_train_state(cfg, jax.random.PRNGKey(0))
-    state = ckpt.restore(os.path.join(args.run_dir, "checkpoints"), template)
+    # G-only restore: ema_params + w_avg against an ABSTRACT template —
+    # no discriminator init, no optimizer leaves read (ISSUE 10).
+    bundle = load_generator(args.run_dir)
+    cfg = bundle.cfg
     if args.attention_backend:
-        import dataclasses
-
         from gansformer_tpu.ops.pallas_attention import resolve_backend
 
         if args.save_attention and args.attention_backend != "xla":
@@ -72,7 +89,27 @@ def main(argv=None) -> None:
         cfg = dataclasses.replace(cfg, model=dataclasses.replace(
             cfg.model,
             attention_backend=resolve_backend(args.attention_backend)))
-    fns = make_train_steps(cfg, batch_size=args.batch_size)
+        bundle = dataclasses.replace(bundle, cfg=cfg)
+
+    programs = ServePrograms(
+        bundle, buckets=(args.batch_size,),
+        manifest_dir=None if args.no_warm_start else default_manifest_dir())
+    restore_ms = telemetry.gauge("serve/restore_ms").value
+    print(f"G-only restore: {restore_ms:.0f} ms "
+          f"(no discriminator/optimizer init)")
+
+    def sample_batch(z: np.ndarray, noise_key, label=None) -> np.ndarray:
+        """z [n ≤ batch-size, num_ws, latent] → images [n, R, R, C]
+        through the split programs, bucket-padded."""
+        n = z.shape[0]
+        z = _pad_rows(np.asarray(z, np.float32), args.batch_size)
+        label = (None if label is None
+                 else _pad_rows(np.asarray(label, np.float32),
+                                args.batch_size))
+        ws = programs.map_z(z, label)
+        psi = np.full((args.batch_size,), args.truncation_psi, np.float32)
+        imgs = programs.synthesize(ws, psi, np.asarray(noise_key))
+        return np.asarray(jax.device_get(imgs))[:n]
 
     dataset = None
     if cfg.model.label_dim:
@@ -91,16 +128,17 @@ def main(argv=None) -> None:
                               (n, cfg.model.num_ws, cfg.model.latent_dim))
         label = (dataset.random_labels(n, seed=args.seed + i)
                  if dataset is not None else None)
-        imgs = fns.sample(state.ema_params, state.w_avg, z,
-                          jax.random.fold_in(rng, i + 1),
-                          truncation_psi=args.truncation_psi, label=label)
-        all_imgs.append(np.asarray(jax.device_get(imgs)))
+        all_imgs.append(sample_batch(np.asarray(z),
+                                     jax.random.fold_in(rng, i + 1), label))
     imgs = np.concatenate(all_imgs)
 
     if args.save_attention:
         # Re-run one batch collecting the sown attention maps (SURVEY.md
-        # §2.3 — the paper's latent→region visualizations).
+        # §2.3 — the paper's latent→region visualizations).  Needs
+        # mutable-intermediates capture, so it drives the module
+        # directly rather than the AOT programs.
         from gansformer_tpu.models.generator import Generator
+        from gansformer_tpu.train.steps import apply_truncation
         from gansformer_tpu.utils.image import save_attention_grid
 
         if cfg.model.attention == "none":
@@ -111,13 +149,11 @@ def main(argv=None) -> None:
                               (n, cfg.model.num_ws, cfg.model.latent_dim))
         label = (dataset.random_labels(n, seed=args.seed)
                  if dataset is not None else None)
-        from gansformer_tpu.train.steps import apply_truncation
-
-        ws = G.apply({"params": state.ema_params}, z, label,
+        ws = G.apply({"params": bundle.ema_params}, z, label,
                      method=Generator.map)
-        ws = apply_truncation(ws, state.w_avg, args.truncation_psi)
+        ws = apply_truncation(ws, bundle.w_avg, args.truncation_psi)
         att_imgs, aux = G.apply(
-            {"params": state.ema_params}, ws,
+            {"params": bundle.ema_params}, ws,
             rngs={"noise": jax.random.fold_in(rng, 1)},
             method=Generator.synthesize, mutable=["intermediates"])
         attn = aux["intermediates"]["synthesis"]
@@ -135,10 +171,12 @@ def main(argv=None) -> None:
         # interpolation steps.  Done in z-space, mapped per step — the
         # convention of the lineage's interpolation videos.
         rows, steps = args.interpolate
-        za = jax.random.normal(jax.random.fold_in(rng, 101),
-                               (rows, cfg.model.num_ws, cfg.model.latent_dim))
-        zb = jax.random.normal(jax.random.fold_in(rng, 202),
-                               (rows, cfg.model.num_ws, cfg.model.latent_dim))
+        za = np.asarray(jax.random.normal(
+            jax.random.fold_in(rng, 101),
+            (rows, cfg.model.num_ws, cfg.model.latent_dim)))
+        zb = np.asarray(jax.random.normal(
+            jax.random.fold_in(rng, 202),
+            (rows, cfg.model.num_ws, cfg.model.latent_dim)))
         label = (dataset.random_labels(rows, seed=args.seed + 7)
                  if dataset is not None else None)
         strip = []
@@ -146,14 +184,13 @@ def main(argv=None) -> None:
         if rows_eff != rows:                    # capped by --batch-size
             raise SystemExit(f"--interpolate ROWS ({rows}) must be "
                              f"<= --batch-size ({args.batch_size})")
+        # same key on purpose: interpolation frames share their synthesis
+        # noise (the lineage's video convention — only the latent moves)
+        key303 = jax.random.fold_in(rng, 303)
         for s in range(steps):
             t = s / max(steps - 1, 1)
             zt = (1.0 - t) * za + t * zb
-            imgs_t = fns.sample(state.ema_params, state.w_avg, zt,
-                                jax.random.fold_in(rng, 303),
-                                truncation_psi=args.truncation_psi,
-                                label=label)
-            strip.append(np.asarray(jax.device_get(imgs_t)))
+            strip.append(sample_batch(zt, key303, label))  # graftlint: disable=rng-key-reuse — frames share noise by design
         # [steps, rows, H, W, C] → row-major grid: rows × steps
         inter = np.stack(strip, axis=1).reshape(rows * steps,
                                                 *strip[0].shape[1:])
@@ -166,37 +203,42 @@ def main(argv=None) -> None:
         # framework's per-component semantics — SURVEY.md §7.4): cell (r,c)
         # keeps row-source r's leading latent components and takes the
         # suffix (and the global component, if present) from column-source
-        # c.  Mapping runs once per source; mixing happens in w-space.
-        from gansformer_tpu.models.generator import Generator
+        # c.  Mapping runs once per source; mixing happens in w-space —
+        # exactly the traffic shape the serving split exists for (the
+        # mixed cells never touch the mapping network).
         from gansformer_tpu.train.steps import apply_truncation
 
         rows, cols = args.style_mix
-        G = Generator(cfg.model)
 
         def map_ws(key, n, label_seed):
-            z = jax.random.normal(key, (n, cfg.model.num_ws,
-                                        cfg.model.latent_dim))
+            z = np.asarray(jax.random.normal(
+                key, (n, cfg.model.num_ws, cfg.model.latent_dim)))
             label = (dataset.random_labels(n, seed=label_seed)
                      if dataset is not None else None)
-            ws = G.apply({"params": state.ema_params}, z, label,
-                         method=Generator.map)
-            return apply_truncation(ws, state.w_avg, args.truncation_psi)
+            label = (None if label is None
+                     else _pad_rows(np.asarray(label, np.float32),
+                                    args.batch_size))
+            ws = programs.map_z(_pad_rows(z, args.batch_size), label)
+            ws = apply_truncation(ws, bundle.w_avg, args.truncation_psi)
+            return np.asarray(jax.device_get(ws))[:n]
 
         ws_a = map_ws(jax.random.fold_in(rng, 404), rows, args.seed + 11)
         ws_b = map_ws(jax.random.fold_in(rng, 505), cols, args.seed + 12)
         cross = max(1, cfg.model.components // 2)
         # [rows, cols, num_ws, w] — leading components from A, rest from B
         mix = np.broadcast_to(
-            np.asarray(ws_b)[None, :], (rows, cols) + ws_b.shape[1:]).copy()
-        mix[:, :, :cross] = np.asarray(ws_a)[:, None, :cross]
+            ws_b[None, :], (rows, cols) + ws_b.shape[1:]).copy()
+        mix[:, :, :cross] = ws_a[:, None, :cross]
         flat = mix.reshape((-1,) + mix.shape[2:])
         mixed = []
+        key606 = np.asarray(jax.random.fold_in(rng, 606))
+        psi_one = np.ones((args.batch_size,), np.float32)  # already truncated
         for i in range(0, len(flat), args.batch_size):   # respect --batch-size
-            chunk = G.apply({"params": state.ema_params},
-                            jax.numpy.asarray(flat[i:i + args.batch_size]),
-                            rngs={"noise": jax.random.fold_in(rng, 606)},
-                            method=Generator.synthesize)
-            mixed.append(np.asarray(jax.device_get(chunk)))
+            chunk = flat[i:i + args.batch_size]
+            n = chunk.shape[0]
+            out = programs.synthesize(_pad_rows(chunk, args.batch_size),
+                                      psi_one, key606)
+            mixed.append(np.asarray(jax.device_get(out))[:n])
         save_image_grid(np.concatenate(mixed),
                         os.path.join(out_dir, "mix.png"), grid=(cols, rows))
         print(os.path.join(out_dir, "mix.png"))

@@ -1,0 +1,158 @@
+"""``gansformer-serve`` — stand up the AOT-compiled generation service.
+
+Cold-start story (ISSUE 10): enable the persistent XLA compile cache,
+G-only-restore the checkpoint (no discriminator, no optimizer state),
+warm-start every (program, batch-bucket) executable from the serialized
+manifest, and report first-image time — seconds on a warm manifest, vs
+the 30–100 s per-program compiles a cold ``cli/generate.py``-style
+start used to pay.
+
+Modes:
+* default      — warm start, serve ``--images`` demo requests (Zipfian
+                 seed mix), write a grid + ``telemetry.prom`` to
+                 ``--out``, print a JSON summary line.
+* ``--warm-only`` — populate/validate the manifest and exit (the
+                 deploy-time pre-bake step).
+
+No network listener here deliberately: the service core is a Python
+API (``serve.GenerationService``); the transport in front of it is a
+deployment choice.  ``scripts/loadtest_serve.py`` is the load driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="AOT-compiled generation service (warm-startable)")
+    p.add_argument("--run-dir", default=None,
+                   help="run dir / packed archive / URL with checkpoints "
+                        "+ config.json (G/EMA leaves only are loaded)")
+    p.add_argument("--preset", default=None,
+                   help="with --init random: serve a randomly-initialized "
+                        "G of this preset (perf/load testing without a "
+                        "checkpoint)")
+    p.add_argument("--init", default="checkpoint",
+                   choices=("checkpoint", "random"))
+    p.add_argument("--buckets", default="1,4,8",
+                   help="comma list of padded batch buckets to compile")
+    p.add_argument("--psi", type=float, default=0.7)
+    p.add_argument("--images", type=int, default=8,
+                   help="demo requests to serve (0 = none)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None,
+                   help="output dir (default: run dir /served or ./served)")
+    p.add_argument("--manifest-dir", default=None,
+                   help="warm-start manifest location (default: "
+                        ".jax_compile_cache/serve/)")
+    p.add_argument("--no-warm-start", action="store_true",
+                   help="skip the serialized-executable manifest (always "
+                        "compile; the XLA disk cache still applies)")
+    p.add_argument("--warm-only", action="store_true",
+                   help="populate/validate the manifest and exit")
+    p.add_argument("--wcache", type=int, default=4096,
+                   help="w-cache capacity (entries)")
+    args = p.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from gansformer_tpu.core.config import get_preset
+    from gansformer_tpu.obs import install_compile_listener
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import (
+        GenerationService, ServePrograms, default_manifest_dir,
+        init_generator, load_generator)
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
+    from gansformer_tpu.utils.image import save_image_grid
+    from gansformer_tpu.utils.runarchive import resolve_run_dir
+
+    enable_compile_cache()
+    install_compile_listener()
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+
+    t_load0 = time.perf_counter()
+    if args.init == "random":
+        if not args.preset:
+            raise SystemExit("--init random needs --preset")
+        bundle = init_generator(get_preset(args.preset).validate(),
+                                seed=args.seed)
+        out_dir = args.out or "served"
+    else:
+        if not args.run_dir:
+            raise SystemExit("--init checkpoint needs --run-dir")
+        run_dir = resolve_run_dir(args.run_dir)
+        bundle = load_generator(run_dir)
+        out_dir = args.out or os.path.join(run_dir, "served")
+    load_ms = (time.perf_counter() - t_load0) * 1000.0
+
+    manifest_dir = None if args.no_warm_start else (
+        args.manifest_dir or default_manifest_dir())
+    programs = ServePrograms(bundle, buckets=buckets,
+                             manifest_dir=manifest_dir)
+    warm = programs.warm_start()
+
+    summary = {
+        "buckets": list(buckets),
+        "restore_ms": round(load_ms, 1),
+        "warm_start": {"loaded": warm["loaded"],
+                       "compiled": warm["compiled"],
+                       "seconds": round(warm["seconds"], 3)},
+        "manifest_dir": manifest_dir,
+        "device": {"platform": jax.devices()[0].platform,
+                   "kind": jax.devices()[0].device_kind,
+                   "count": len(jax.devices())},
+    }
+
+    if not args.warm_only and args.images > 0:
+        if bundle.cfg.model.label_dim:
+            # the demo loop has no label source; crashing the
+            # dispatcher on the first unlabeled request would surface
+            # as an opaque "generation request failed" instead
+            raise SystemExit(
+                f"model has label_dim={bundle.cfg.model.label_dim}: the "
+                f"demo traffic can't supply labels — use --warm-only to "
+                f"pre-bake the manifest, and drive conditional requests "
+                f"through serve.GenerationService.submit(label=...)")
+        os.makedirs(out_dir, exist_ok=True)
+        rng = np.random.RandomState(args.seed)
+        # Zipfian demo mix: a few hot seeds + a long tail, so the demo
+        # exercises the w-cache the way real traffic would
+        universe = np.arange(1, 64)
+        pz = 1.0 / universe ** 1.1
+        seeds = rng.choice(universe, size=args.images, p=pz / pz.sum())
+        with GenerationService(programs,
+                               wcache_capacity=args.wcache) as svc:
+            t0 = time.perf_counter()
+            first = svc.submit(int(seeds[0]), psi=args.psi)
+            first.result(timeout=600)
+            summary["first_image_ms"] = round(
+                (time.perf_counter() - t0) * 1000.0, 1)
+            tickets = [svc.submit(int(s), psi=args.psi)
+                       for s in seeds[1:]]
+            imgs = [first.result()] + [t.result(timeout=600)
+                                       for t in tickets]
+        save_image_grid(np.stack(imgs),
+                        os.path.join(out_dir, "served_grid.png"))
+        snap = telemetry.get_registry().snapshot()
+        summary["counters"] = {
+            k.replace("serve/", ""): v
+            for k, v in snap["counters"].items() if k.startswith("serve/")}
+        lat = snap["histograms"].get("serve/e2e_ms", {})
+        summary["e2e_ms"] = {k: lat.get(k) for k in
+                             ("count", "mean", "min", "max")}
+        telemetry.get_registry().write_prom(
+            os.path.join(out_dir, "telemetry.prom"))
+        summary["out"] = out_dir
+
+    print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

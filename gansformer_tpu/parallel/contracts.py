@@ -150,6 +150,16 @@ ENTRY_CONTRACTS: Dict[str, Contract] = {
     "ppl_pairs": Contract(args=("params", "batch", "batch", "batch",
                                 "rng"),
                           outs=("batch",)),
+    # The serving split (ISSUE 10, serve/programs.py): params always
+    # replicated (weight-agnostic executables), per-request rows on
+    # ``data``.  serve_map_seeds(params, seeds[B]) / serve_map_z(params,
+    # z) → ws[B,…]; serve_synth(params, w_avg, ws, psi[B], rng) → imgs.
+    "serve_map_seeds": Contract(args=("params", "batch"),
+                                outs=("batch",)),
+    "serve_map_z": Contract(args=("params", "batch"), outs=("batch",)),
+    "serve_synth": Contract(args=("params", "stat", "batch", "batch",
+                                  "rng"),
+                            outs=("batch",)),
 }
 
 

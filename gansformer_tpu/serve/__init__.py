@@ -1,0 +1,28 @@
+"""Serving subsystem — AOT-compiled generation as a service (ISSUE 10).
+
+Four layers, bottom-up:
+
+* ``warmstart`` — serialized-executable manifest next to the persistent
+  XLA compile cache: a cold process deserializes instead of compiling.
+* ``programs``  — the generator split at the mapping/synthesis boundary
+  (``map_seeds`` / ``map_z`` / ``synthesize``, ψ traced per-row) AOT-
+  compiled per batch bucket, plus the G-only checkpoint surface
+  (``load_generator`` — no discriminator, no optimizer state).
+* ``cache``     — LRU w-cache keyed by (seed, label): repeat /
+  interpolation / style-mix traffic skips the mapping network.
+* ``service``   — continuous-batching request queue + dispatcher thread
+  with queue-depth / batch-fill / latency SLO telemetry.
+
+``cli/serve.py`` (``gansformer-serve``) and
+``scripts/loadtest_serve.py`` sit on top; ``docs/serving.md`` is the
+operator guide.
+"""
+
+from gansformer_tpu.serve.cache import WCache, wcache_key  # noqa: F401
+from gansformer_tpu.serve.programs import (  # noqa: F401
+    DEFAULT_BUCKETS, GeneratorBundle, ServePrograms, bucket_for,
+    generator_fns, init_generator, load_generator)
+from gansformer_tpu.serve.service import (  # noqa: F401
+    GenerationService, Ticket)
+from gansformer_tpu.serve.warmstart import (  # noqa: F401
+    default_manifest_dir)

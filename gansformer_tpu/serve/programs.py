@@ -1,0 +1,395 @@
+"""Split generation programs — the serving half of the generator.
+
+The train-side sampler (``train/steps.py _sample``) is ONE jitted
+program: mapping + truncation + synthesis, ψ a *static* argument (a new
+executable per ψ value) built from a full ``TrainState`` (G **and** D
+and both optimizers).  A service wants the opposite of all three
+choices, so this module splits the generator at the mapping/synthesis
+boundary (the compiler-first cached-intermediate shape of arxiv
+2603.09555, PAPERS.md):
+
+* ``map_seeds``  — ``(params, seeds[B]) → ws``: per-row latent draw
+  (z_i is a pure function of seed_i — the cache key IS the content
+  address) + mapping network.  Row-independent, so bucket padding
+  leaves the real rows bit-identical (held by tests/test_serve.py).
+* ``map_z``      — ``(params, z) → ws``: explicit-latent flavor for
+  interpolation / parity with the training sampler.
+* ``synthesize`` — ``(params, w_avg, ws, psi[B], rng) → imgs``:
+  truncation + synthesis.  ψ rides as a TRACED per-row vector, so ONE
+  executable covers every ψ (and mixed-ψ batches); keeping truncation
+  here — not in the map programs — makes the w-cache ψ-independent:
+  one cached mapping serves every truncation setting.
+
+``ServePrograms`` AOT-lowers each (kind, batch-bucket) pair to a
+``Compiled`` executable, warm-starting from the serialized-executable
+manifest (``serve/warmstart.py``) when a valid entry exists — a cold
+process start with a populated manifest compiles ZERO programs.
+Telemetry: ``serve/compiles_total``, ``serve/compile_ms``,
+``serve/map_dispatch_total``, ``serve/synth_dispatch_total``.
+
+``load_generator`` is the matching checkpoint surface: the G-only
+partial restore (``checkpoint.restore_selected`` over an ABSTRACT
+template) that reads ``ema_params`` + ``w_avg`` and never initializes
+the discriminator or the optimizers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from types import SimpleNamespace
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gansformer_tpu.core.config import ExperimentConfig
+from gansformer_tpu.obs import registry as telemetry
+
+DEFAULT_BUCKETS = (1, 2, 4, 8)
+
+# Serving programs a warm start pre-builds by default.  ``map_z`` is the
+# explicit-latent flavor only the generate CLI's interpolation path
+# needs — it compiles (and manifests) on first use instead.
+WARM_KINDS = ("map_seeds", "synthesize")
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneratorBundle:
+    """Everything generation needs — and nothing else."""
+
+    cfg: ExperimentConfig
+    ema_params: Any                  # the Gs tree (EMA generator)
+    w_avg: Any                       # [w_dim] truncation anchor
+
+
+def sorted_buckets(buckets: Iterable[int]) -> Tuple[int, ...]:
+    out = tuple(sorted({int(b) for b in buckets}))
+    if not out or out[0] < 1:
+        raise ValueError(f"batch buckets must be positive ints, got "
+                        f"{buckets!r}")
+    return out
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket ≥ n; requests beyond the largest bucket are the
+    caller's job to chunk (the service pops at most max-bucket rows)."""
+    if n < 1:
+        raise ValueError(f"bucket_for: need n >= 1, got {n}")
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"batch of {n} exceeds the largest bucket "
+                     f"{buckets[-1]} — chunk the request batch first")
+
+
+def generator_fns(cfg: ExperimentConfig) -> SimpleNamespace:
+    """The three pure program bodies (named for device-time
+    attribution: the profiler labels HloModules after ``__name__``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gansformer_tpu.models.generator import Generator
+
+    m = cfg.model
+    G = Generator(m)
+
+    def serve_map_seeds(params, seeds, label=None):
+        def one(seed):
+            return jax.random.normal(
+                jax.random.PRNGKey(seed), (m.num_ws, m.latent_dim),
+                jnp.float32)
+
+        z = jax.vmap(one)(seeds)
+        return G.apply({"params": params}, z, label, method=Generator.map)
+
+    def serve_map_z(params, z, label=None):
+        return G.apply({"params": params}, z, label, method=Generator.map)
+
+    def serve_synth(params, w_avg, ws, psi, rng):
+        # per-row traced ψ: ws' = w̄ + ψ·(ws − w̄) — the truncation
+        # trick with the EMA anchor, applied HERE (not at mapping time)
+        # so cached w rows stay valid for every ψ
+        wa = w_avg[None, None, :]
+        ws = wa + psi[:, None, None].astype(ws.dtype) * (ws - wa)
+
+        # Per-row noise keys via vmap, NOT one batch-shaped draw: a
+        # single key over a [B,H,W,1] draw makes row i's noise depend
+        # on B (threefry counters pair across the whole array), which
+        # would break the bucketed-padding parity contract — a padded
+        # batch must produce bit-identical prefix rows
+        # (tests/test_serve.py).  vmap keeps the batched lowering.
+        def one(ws_row, key):
+            return G.apply({"params": params}, ws_row[None],
+                           rngs={"noise": key},
+                           method=Generator.synthesize)[0]
+
+        keys = jax.vmap(jax.random.fold_in, (None, 0))(
+            rng, jnp.arange(ws.shape[0], dtype=jnp.uint32))
+        return jax.vmap(one, (0, 0))(ws, keys)
+
+    serve_map_seeds.__name__ = "serve_map_seeds"
+    serve_map_z.__name__ = "serve_map_z"
+    serve_synth.__name__ = "serve_synth"
+    return SimpleNamespace(map_seeds=serve_map_seeds, map_z=serve_map_z,
+                           synthesize=serve_synth)
+
+
+class ServePrograms:
+    """AOT-compiled (kind × batch-bucket) generation executables with
+    manifest warm start.
+
+    Params are ARGUMENTS, not closure constants: the executables are
+    weight-agnostic, so one manifest serves every checkpoint of an
+    architecture and a weight refresh never recompiles anything.
+    """
+
+    def __init__(self, bundle: GeneratorBundle,
+                 buckets: Iterable[int] = DEFAULT_BUCKETS,
+                 manifest_dir: Optional[str] = None,
+                 warm_start: bool = True):
+        self.bundle = bundle
+        self.buckets = sorted_buckets(buckets)
+        self.manifest_dir = manifest_dir
+        self.warm_start_enabled = warm_start and manifest_dir is not None
+        self._fns = generator_fns(bundle.cfg)
+        self._compiled: Dict[Tuple[str, int], Any] = {}
+        self._model_json = json.dumps(
+            dataclasses.asdict(bundle.cfg.model), sort_keys=True)
+        # explicit zeros for the schema lint (see serve/service.py)
+        telemetry.counter("serve/map_dispatch_total")
+        telemetry.counter("serve/synth_dispatch_total")
+        telemetry.counter("serve/compiles_total")
+
+    # -- shapes --------------------------------------------------------------
+
+    def _abstract_args(self, kind: str, bucket: int) -> Tuple[Any, ...]:
+        import jax
+
+        m = self.bundle.cfg.model
+        params_abs = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+            self.bundle.ema_params)
+        label_abs = (
+            (jax.ShapeDtypeStruct((bucket, m.label_dim), np.float32),)
+            if m.label_dim else ())
+        if kind == "map_seeds":
+            return (params_abs,
+                    jax.ShapeDtypeStruct((bucket,), np.int32)) + label_abs
+        if kind == "map_z":
+            return (params_abs,
+                    jax.ShapeDtypeStruct(
+                        (bucket, m.num_ws, m.latent_dim),
+                        np.float32)) + label_abs
+        if kind == "synthesize":
+            return (params_abs,
+                    jax.ShapeDtypeStruct((m.w_dim,), np.float32),
+                    jax.ShapeDtypeStruct((bucket, m.num_ws, m.w_dim),
+                                         np.float32),
+                    jax.ShapeDtypeStruct((bucket,), np.float32),
+                    jax.ShapeDtypeStruct((2,), np.uint32))
+        raise KeyError(f"unknown serve program kind {kind!r}")
+
+    # -- compile / warm start ------------------------------------------------
+
+    def _get(self, kind: str, bucket: int) -> Any:
+        import jax
+
+        from gansformer_tpu.serve import warmstart
+
+        ck = (kind, bucket)
+        if ck in self._compiled:
+            return self._compiled[ck]
+        key = f"{kind}_b{bucket}"
+        fp = warmstart.fingerprint(self._model_json, kind, bucket)
+        if self.warm_start_enabled:
+            compiled = warmstart.load_executable(self.manifest_dir, key, fp)
+            if compiled is not None:
+                self._compiled[ck] = compiled
+                return compiled
+        fn = getattr(self._fns, kind)
+        t0 = time.perf_counter()
+        compiled = self._compile(jax.jit(fn), kind, bucket)
+        telemetry.counter("serve/compiles_total").inc()
+        telemetry.histogram("serve/compile_ms").observe(
+            (time.perf_counter() - t0) * 1000.0)
+        if self.warm_start_enabled:
+            warmstart.save_executable(self.manifest_dir, key, compiled, fp)
+        self._compiled[ck] = compiled
+        return compiled
+
+    def _compile(self, jitted: Any, kind: str, bucket: int) -> Any:
+        """One AOT compile, with the persistent XLA disk cache DISABLED
+        when the result is destined for the manifest: an executable that
+        was a disk-cache *hit* deserializes against runtime-generated
+        symbol names that no longer exist ("Symbols not found" from
+        ``serialize_executable`` round-trips — reproduced on jax 0.4.37
+        CPU), so a manifest written from cache hits silently loses its
+        warm start.  Unsetting ``jax_compilation_cache_dir`` is the
+        lever that works (``jax_enable_compilation_cache=False`` does
+        NOT gate this path on 0.4.37 — entries still read/write); the
+        save path additionally verifies every blob round-trips before
+        the manifest records it (``warmstart.save_executable``).  The
+        manifest supersedes the XLA cache for serving anyway — both
+        layers caching the same program buys nothing."""
+        import jax
+
+        args = self._abstract_args(kind, bucket)
+        if not self.warm_start_enabled:
+            return jitted.lower(*args).compile()
+        try:
+            from jax._src import compilation_cache as cc
+        except ImportError:            # layout drift in a future jax
+            cc = None
+        prev = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+        if cc is not None:
+            cc.reset_cache()   # the module LATCHES the dir at first use
+        try:
+            return jitted.lower(*args).compile()
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            if cc is not None:
+                cc.reset_cache()       # re-latch from the restored dir
+
+    def warm_start(self, kinds: Sequence[str] = WARM_KINDS) -> Dict[str, Any]:
+        """Materialize every (kind, bucket) executable — from the
+        manifest when valid, compiling (and re-serializing) otherwise.
+        Returns {loaded, compiled, seconds}."""
+        before_hits = telemetry.counter("serve/warm_hits_total").value
+        before_compiles = telemetry.counter("serve/compiles_total").value
+        t0 = time.perf_counter()
+        for kind in kinds:
+            for bucket in self.buckets:
+                self._get(kind, bucket)
+        return {
+            "loaded": int(telemetry.counter("serve/warm_hits_total").value
+                          - before_hits),
+            "compiled": int(telemetry.counter("serve/compiles_total").value
+                            - before_compiles),
+            "seconds": time.perf_counter() - t0,
+        }
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _label_args(self, bucket: int, label) -> Tuple[Any, ...]:
+        if not self.bundle.cfg.model.label_dim:
+            if label is not None:
+                raise ValueError("label passed to an unconditional model")
+            return ()
+        if label is None:
+            raise ValueError(
+                f"model has label_dim={self.bundle.cfg.model.label_dim}; "
+                f"requests must carry a label vector")
+        label = np.asarray(label, np.float32)
+        if label.shape != (bucket, self.bundle.cfg.model.label_dim):
+            raise ValueError(f"label shape {label.shape} != "
+                             f"({bucket}, "
+                             f"{self.bundle.cfg.model.label_dim})")
+        return (label,)
+
+    def map_seeds(self, seeds: np.ndarray, label=None):
+        """seeds [bucket]int32 → ws [bucket, num_ws, w_dim] (device)."""
+        seeds = np.ascontiguousarray(seeds, np.int32)
+        bucket = bucket_for(len(seeds), self.buckets)
+        if len(seeds) != bucket:
+            raise ValueError(f"map_seeds takes a full bucket "
+                             f"({self.buckets}); pad {len(seeds)} rows "
+                             f"to {bucket} first")
+        telemetry.counter("serve/map_dispatch_total").inc()
+        return self._get("map_seeds", bucket)(
+            self.bundle.ema_params, seeds,
+            *self._label_args(bucket, label))
+
+    def map_z(self, z: np.ndarray, label=None):
+        z = np.ascontiguousarray(z, np.float32)
+        bucket = bucket_for(z.shape[0], self.buckets)
+        if z.shape[0] != bucket:
+            raise ValueError(f"map_z takes a full bucket "
+                             f"({self.buckets}); pad {z.shape[0]} rows "
+                             f"to {bucket} first")
+        telemetry.counter("serve/map_dispatch_total").inc()
+        return self._get("map_z", bucket)(
+            self.bundle.ema_params, z, *self._label_args(bucket, label))
+
+    def synthesize(self, ws, psi, rng):
+        """ws [bucket, num_ws, w_dim], psi [bucket]f32, rng (2,)uint32 →
+        imgs [bucket, R, R, C] (device, unfetched)."""
+        ws = np.ascontiguousarray(ws, np.float32) \
+            if isinstance(ws, np.ndarray) else ws
+        psi = np.ascontiguousarray(psi, np.float32)
+        bucket = bucket_for(psi.shape[0], self.buckets)
+        if psi.shape[0] != bucket or ws.shape[0] != bucket:
+            raise ValueError(f"synthesize takes a full bucket "
+                             f"({self.buckets}); pad "
+                             f"{psi.shape[0]}/{ws.shape[0]} rows to "
+                             f"{bucket} first")
+        telemetry.counter("serve/synth_dispatch_total").inc()
+        return self._get("synthesize", bucket)(
+            self.bundle.ema_params, self.bundle.w_avg, ws, psi, rng)
+
+
+# -- checkpoint surface ------------------------------------------------------
+
+def _is_generator_leaf(path) -> bool:
+    from gansformer_tpu.parallel.contracts import key_str
+
+    return key_str(path[0]) in ("ema_params", "w_avg") if path else False
+
+
+def load_generator(run_dir: str,
+                   cfg: Optional[ExperimentConfig] = None,
+                   step: Optional[int] = None) -> GeneratorBundle:
+    """G-only checkpoint load: ``ema_params`` + ``w_avg`` from
+    ``<run_dir>/checkpoints`` against an ABSTRACT template — the
+    discriminator and both optimizer states are never initialized,
+    never read, never put on device (the cost lands in the
+    ``serve/restore_ms`` gauge; tests/test_serve.py compares it against
+    the full init+restore path).  Legacy Orbax checkpoints (no
+    ``state.npz``) fall back to the full concrete restore."""
+    import jax
+
+    from gansformer_tpu.train import checkpoint as ckpt
+    from gansformer_tpu.train.state import create_train_state
+
+    if cfg is None:
+        with open(os.path.join(run_dir, "config.json")) as f:
+            cfg = ExperimentConfig.from_json(f.read())
+    ckpt_dir = os.path.join(run_dir, "checkpoints")
+    t0 = time.perf_counter()
+    template = jax.eval_shape(lambda k: create_train_state(cfg, k),
+                              jax.random.PRNGKey(0))
+    try:
+        partial = ckpt.restore_selected(ckpt_dir, template,
+                                        _is_generator_leaf, step=step)
+    except FileNotFoundError as e:
+        if "Orbax" not in str(e) and "pre-npz" not in str(e):
+            raise
+        # legacy step dir: pay the full init+restore once
+        full_template = create_train_state(cfg, jax.random.PRNGKey(0))
+        partial = ckpt.restore(ckpt_dir, full_template, step=step)
+    telemetry.gauge("serve/restore_ms").set(
+        (time.perf_counter() - t0) * 1000.0)
+    return GeneratorBundle(cfg=cfg, ema_params=partial.ema_params,
+                           w_avg=partial.w_avg)
+
+
+def init_generator(cfg: ExperimentConfig, seed: int = 0) -> GeneratorBundle:
+    """Randomly-initialized G-only bundle (no checkpoint) — the
+    load-test / battery path, where serving PERFORMANCE is measured on
+    the real architecture without needing trained weights."""
+    import jax
+    import jax.numpy as jnp
+
+    from gansformer_tpu.models.generator import Generator
+
+    m = cfg.model
+    G = Generator(m)
+    k_g, k_noise = jax.random.split(jax.random.PRNGKey(seed))
+    z = jnp.zeros((2, m.num_ws, m.latent_dim), jnp.float32)
+    label = jnp.zeros((2, m.label_dim), jnp.float32) if m.label_dim \
+        else None
+    g_vars = G.init({"params": k_g, "noise": k_noise}, z, label=label)
+    return GeneratorBundle(cfg=cfg, ema_params=g_vars["params"],
+                           w_avg=jnp.zeros((m.w_dim,), jnp.float32))

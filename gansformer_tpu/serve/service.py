@@ -1,0 +1,259 @@
+"""Continuous-batching generation service — the request front end.
+
+One dispatcher thread (``utils/background.LoopWorker``) runs
+``_serve_dispatch``: pop whatever is queued (up to the largest compiled
+bucket, waiting ``max_fill_wait_ms`` after the first arrival to improve
+fill), resolve each request's w row — LRU cache hit or a bucketed
+``map_seeds`` dispatch for the misses — pad to the next bucket, run the
+ψ-vectorized synthesis executable, fetch, slice, fulfill tickets.  An
+all-miss batch (cold-seed traffic) keeps ws ON DEVICE between the two
+programs — the cache-fill fetch rides after the synthesis dispatch, so
+the host copy overlaps the synth compute instead of serializing
+map → host → synth.
+Continuous batching: the queue drains whenever the device is free; a
+batch is NEVER held for stragglers beyond the fill wait, and oversize
+backlogs chunk at the max bucket per iteration.
+
+The dispatch loop is under the ``hot-loop-sync`` lint discipline
+(analysis/rules/hot_loop.py): the only host syncs in the ``while`` body
+live inside ``with span("serve_fetch")`` — the serving twin of the
+train loop's ``tick_fetch`` contract, so a future edit that sneaks a
+hidden ``block_until_ready`` into the dispatch path fails tier-1.
+
+SLO telemetry (obs/registry → ``telemetry.prom``):
+``serve/queue_depth`` histogram+gauge, ``serve/batch_fill`` histogram
+(rows/bucket), ``serve/e2e_ms`` histogram (submit→ready),
+``serve/batch_ms`` histogram (dispatch+fetch), counters
+``serve/requests_total`` / ``serve/images_total`` /
+``serve/map_dispatch_total`` / ``serve/synth_dispatch_total`` and the
+w-cache pair, plus the LoopWorker's ``serve/dispatch_heartbeat``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+from gansformer_tpu.obs import registry as telemetry
+from gansformer_tpu.obs.spans import span
+from gansformer_tpu.serve.cache import WCache, wcache_key
+from gansformer_tpu.serve.programs import ServePrograms, bucket_for
+from gansformer_tpu.utils.background import LoopWorker
+
+
+class Ticket:
+    """One submitted request; ``result()`` blocks until fulfilled."""
+
+    __slots__ = ("seed", "psi", "label", "t_submit", "t_done",
+                 "_event", "_image", "_error")
+
+    def __init__(self, seed: int, psi: float, label):
+        self.seed = int(seed)
+        self.psi = float(psi)
+        self.label = label
+        self.t_submit = time.perf_counter()
+        self.t_done: Optional[float] = None
+        self._event = threading.Event()
+        self._image: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+
+    def _fulfill(self, image: np.ndarray) -> None:
+        self._image = image
+        self.t_done = time.perf_counter()
+        telemetry.histogram("serve/e2e_ms").observe(
+            (self.t_done - self.t_submit) * 1000.0)
+        self._event.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+        self.t_done = time.perf_counter()
+        self._event.set()
+
+    @property
+    def latency_ms(self) -> Optional[float]:
+        if self.t_done is None:
+            return None
+        return (self.t_done - self.t_submit) * 1000.0
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request (seed={self.seed}) not served in {timeout}s")
+        if self._error is not None:
+            raise RuntimeError("generation request failed") from self._error
+        return self._image
+
+
+class GenerationService:
+    """Front a ``ServePrograms`` with a continuous-batching queue."""
+
+    def __init__(self, programs: ServePrograms,
+                 max_fill_wait_ms: float = 2.0,
+                 wcache_capacity: int = 4096,
+                 noise_seed: int = 0):
+        self.programs = programs
+        self._max_bucket = programs.buckets[-1]
+        self._fill_wait_s = max(0.0, max_fill_wait_ms) / 1000.0
+        self.wcache = WCache(wcache_capacity)
+        self._noise_seed = int(noise_seed)
+        self._batches = 0
+        self._pending: "deque[Ticket]" = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        # materialize every SLO family up front so an idle (or
+        # all-hit / all-miss) service still exports explicit zeros —
+        # the serve-family schema lint reads absence as rotted wiring
+        for name in ("serve/queue_depth", "serve/batch_fill",
+                     "serve/e2e_ms", "serve/batch_ms"):
+            telemetry.histogram(name)
+        for name in ("serve/requests_total", "serve/images_total"):
+            telemetry.counter(name)
+        self._worker = LoopWorker(self._serve_dispatch,
+                                  "serve/dispatch").start()
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, seed: int, psi: float = 0.7, label=None) -> Ticket:
+        self._worker.poll()            # surface a dead dispatcher HERE
+        t = Ticket(seed, psi, label)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("service is closed")
+            self._pending.append(t)
+            telemetry.gauge("serve/queue_depth_now").set(len(self._pending))
+            self._cv.notify()
+        telemetry.counter("serve/requests_total").inc()
+        return t
+
+    def close(self, timeout: float = 30.0) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._worker.join(timeout)
+        with self._cv:
+            leftovers = list(self._pending)
+            self._pending.clear()
+        for t in leftovers:
+            t._fail(RuntimeError("service closed with request queued"))
+
+    def __enter__(self) -> "GenerationService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- consumer side (dispatcher thread) -----------------------------------
+
+    def _pop_batch(self) -> Optional[List[Ticket]]:
+        """Up to max-bucket queued tickets; None on shutdown.  After the
+        first arrival, waits at most ``max_fill_wait_ms`` for the batch
+        to fill — continuous batching, not fixed-size batching."""
+        with self._cv:
+            while not self._pending and not self._stop:
+                self._cv.wait(0.25)
+            if not self._pending:
+                return None            # stopped and drained
+            if self._fill_wait_s > 0 and \
+                    len(self._pending) < self._max_bucket:
+                deadline = time.monotonic() + self._fill_wait_s
+                while len(self._pending) < self._max_bucket and \
+                        not self._stop:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cv.wait(left)
+            depth = len(self._pending)
+            take = min(depth, self._max_bucket)
+            batch = [self._pending.popleft() for _ in range(take)]
+            telemetry.histogram("serve/queue_depth").observe(depth)
+            telemetry.gauge("serve/queue_depth_now").set(len(self._pending))
+        return batch
+
+    def _serve_dispatch(self) -> None:
+        """The dispatch hot loop (hot-loop-sync discipline: device
+        fetches only inside ``span("serve_fetch")``)."""
+        import jax
+
+        programs, cache = self.programs, self.wcache
+        buckets = programs.buckets
+        label_dim = programs.bundle.cfg.model.label_dim
+        while True:
+            batch = self._pop_batch()
+            if batch is None:
+                return
+            self._worker.beat()
+            t0 = time.perf_counter()
+            try:
+                n = len(batch)
+                bucket = bucket_for(n, buckets)
+                telemetry.histogram("serve/batch_fill").observe(n / bucket)
+                rows: List[Optional[np.ndarray]] = [None] * n
+                miss: List[int] = []
+                for i, t in enumerate(batch):
+                    row = cache.get(wcache_key(t.seed, t.label))
+                    if row is None:
+                        miss.append(i)
+                    else:
+                        rows[i] = row
+                psi = np.full((bucket,), 1.0, np.float32)
+                psi[:n] = [t.psi for t in batch]
+                self._batches += 1
+                noise = np.array([self._noise_seed, self._batches],
+                                 np.uint32)
+
+                def map_misses():
+                    mb = bucket_for(len(miss), buckets)
+                    seeds = np.full((mb,), batch[miss[-1]].seed, np.int32)
+                    seeds[:len(miss)] = [batch[i].seed for i in miss]
+                    mlabel = None
+                    if label_dim:
+                        mlabel = np.zeros((mb, label_dim), np.float32)
+                        for j, i in enumerate(miss):
+                            mlabel[j] = batch[i].label
+                    return programs.map_seeds(seeds, mlabel)
+
+                def cache_fill(ws_host):
+                    for j, i in enumerate(miss):
+                        cache.put(wcache_key(batch[i].seed,
+                                             batch[i].label), ws_host[j])
+
+                if len(miss) == n:
+                    # all-miss (the cold-seed traffic the first-image
+                    # story cares about): ws stays ON DEVICE between
+                    # the two programs — no host round-trip before
+                    # synthesis; the cache fill rides a fetch that
+                    # happens AFTER the synth dispatch, overlapping
+                    # the copy with the synthesis compute.  miss
+                    # bucket == synth bucket here (same n).
+                    ws_dev = map_misses()
+                    imgs_dev = programs.synthesize(ws_dev, psi, noise)
+                    with span("serve_fetch"):
+                        cache_fill(np.asarray(jax.device_get(ws_dev)))
+                else:
+                    if miss:
+                        ws_dev = map_misses()
+                        with span("serve_fetch"):
+                            ws_miss = np.asarray(jax.device_get(ws_dev))
+                        cache_fill(ws_miss)
+                        for j, i in enumerate(miss):
+                            rows[i] = ws_miss[j]
+                    # pad to the synthesis bucket by repeating the last
+                    # real row (row-independence keeps the prefix
+                    # bit-identical)
+                    ws = np.stack(rows + [rows[-1]] * (bucket - n))
+                    imgs_dev = programs.synthesize(ws, psi, noise)
+                with span("serve_fetch"):
+                    imgs = np.asarray(jax.device_get(imgs_dev))
+                for i, t in enumerate(batch):
+                    t._fulfill(imgs[i])
+                telemetry.counter("serve/images_total").inc(n)
+                telemetry.histogram("serve/batch_ms").observe(
+                    (time.perf_counter() - t0) * 1000.0)
+            except BaseException as e:
+                for t in batch:
+                    t._fail(e)
+                raise   # sticky on the LoopWorker; submitters see poll()

@@ -1,0 +1,182 @@
+"""Serialized-executable warm-start manifest — seconds, not minutes.
+
+The persistent XLA compile cache (``utils/hostenv.enable_compile_cache``)
+already turns a *re-compile* into a disk hit, but a cold serving process
+still pays tracing + lowering + cache lookup per program — and a cache
+miss (new jaxlib, evicted entry) silently costs the full 30–100 s
+compile (BENCH_TPU_MEASURED ``compile_s``).  This module removes the
+guesswork: every AOT-compiled serving executable is **serialized to
+disk** (``jax.experimental.serialize_executable``) next to an explicit
+``manifest.json`` that records exactly what the bytes are valid for —
+jax version, backend platform/device kind/count, model architecture,
+program kind, and batch bucket.  A warm process start is then
+
+    load manifest → fingerprint match → deserialize → serve
+
+with ZERO compiles (asserted by the warm-start regression test via the
+``compile/compiles_total`` registry counter).  Any mismatch — stale
+fingerprint, torn file, checksum drift, deserialization error — falls
+back to recompile-and-rewrite instead of crashing: the manifest is an
+accelerator, never a correctness dependency.
+
+One sharp edge, handled in ``ServePrograms._compile``: an executable
+that was an XLA *disk-cache hit* serializes into a blob that later
+fails to deserialize ("Symbols not found" — the cached binary refers to
+runtime-generated symbols of the process that wrote it), so compiles
+destined for this manifest run with the persistent XLA cache disabled.
+The manifest supersedes the disk cache for serving; the disk cache
+still accelerates every non-serving entry point.
+
+Layout (``manifest_dir``, default ``.jax_compile_cache/serve/``)::
+
+    manifest.json                     {"version": 1, "entries": {key: …}}
+    <key>.bin                         pickle of (payload, in_tree, out_tree)
+
+Manifest entry::
+
+    {"file": "<key>.bin", "sha256": "…", "fingerprint": "…",
+     "jax": "0.4.37", "platform": "cpu", "device_kind": "…",
+     "n_devices": 1, "written_at": 1700000000.0}
+
+Telemetry: ``serve/warm_hits_total`` (deserialized loads),
+``serve/manifest_stale_total`` (entries rejected — the fallback path),
+``serve/executables_saved_total``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+from gansformer_tpu.obs import registry as telemetry
+from gansformer_tpu.obs.registry import atomic_write_text
+
+MANIFEST = "manifest.json"
+PROTOCOL = 1
+
+
+def backend_signature() -> Dict[str, Any]:
+    """What an executable's bytes are pinned to: the exact runtime."""
+    import jax
+
+    devs = jax.devices()
+    return {
+        "jax": jax.__version__,
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+        "n_devices": len(devs),
+        "protocol": PROTOCOL,
+    }
+
+
+def fingerprint(model_cfg_json: str, kind: str, bucket: int) -> str:
+    """Content hash of everything that determines the compiled program:
+    the model architecture (full ModelConfig JSON — resolution, dtype,
+    attention flavor, backend, …), the program kind, the batch bucket,
+    and the backend signature.  Two processes agree on the fingerprint
+    iff the serialized executable is valid for both."""
+    payload = json.dumps({"model": json.loads(model_cfg_json),
+                          "kind": kind, "bucket": bucket,
+                          **backend_signature()}, sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def _load_manifest(manifest_dir: str) -> Dict[str, Any]:
+    path = os.path.join(manifest_dir, MANIFEST)
+    if not os.path.exists(path):
+        return {"version": 1, "entries": {}}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("version") != 1 or not isinstance(
+                data.get("entries"), dict):
+            raise ValueError("bad manifest shape")
+        return data
+    except (ValueError, OSError):
+        # torn/corrupt manifest: start over — the .bin files it pointed
+        # at are re-validated by checksum on every load anyway
+        telemetry.counter("serve/manifest_stale_total").inc()
+        return {"version": 1, "entries": {}}
+
+
+def _sha256(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+def save_executable(manifest_dir: str, key: str, compiled: Any,
+                    fp: str) -> bool:
+    """Serialize ``compiled`` under ``key`` and record it in the
+    manifest (atomic read-modify-replace).  Returns False — and leaves
+    the manifest untouched — when the runtime can't serialize
+    executables OR the serialized blob fails to load back (e.g. the
+    executable was an XLA disk-cache hit, whose blob references symbols
+    of the writing runtime — "Symbols not found" at deserialize);
+    serving continues, only warm start is lost.  The verify pass means
+    the manifest NEVER records bytes the writing process itself cannot
+    load — a corrupted warm start is caught at pre-bake time, not on
+    the serving floor (counted in ``serve/save_verify_failed_total``)."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = se.serialize(compiled)
+        blob = pickle.dumps((payload, in_tree, out_tree))
+        se.deserialize_and_load(*pickle.loads(blob))
+    except Exception:
+        telemetry.counter("serve/save_verify_failed_total").inc()
+        return False
+    os.makedirs(manifest_dir, exist_ok=True)
+    fname = f"{key}.bin"
+    tmp = os.path.join(manifest_dir, f".{fname}.tmp{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, os.path.join(manifest_dir, fname))
+    manifest = _load_manifest(manifest_dir)
+    manifest["entries"][key] = {
+        "file": fname, "sha256": _sha256(blob), "fingerprint": fp,
+        **backend_signature(), "written_at": time.time()}
+    atomic_write_text(os.path.join(manifest_dir, MANIFEST),
+                      json.dumps(manifest, indent=1, sort_keys=True))
+    telemetry.counter("serve/executables_saved_total").inc()
+    return True
+
+
+def load_executable(manifest_dir: str, key: str, fp: str) -> Optional[Any]:
+    """Deserialize the executable recorded under ``key`` iff its
+    manifest entry matches ``fp`` and its bytes match the recorded
+    checksum.  EVERY failure mode — missing entry, stale fingerprint,
+    checksum drift, unpickle/deserialize error — returns None (counted
+    in ``serve/manifest_stale_total`` when an entry existed but was
+    unusable): the caller recompiles and overwrites."""
+    entry = _load_manifest(manifest_dir)["entries"].get(key)
+    if entry is None:
+        return None
+    try:
+        if entry.get("fingerprint") != fp:
+            raise ValueError("stale fingerprint")
+        path = os.path.join(manifest_dir, entry["file"])
+        with open(path, "rb") as f:
+            blob = f.read()
+        if _sha256(blob) != entry.get("sha256"):
+            raise ValueError("checksum mismatch")
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = pickle.loads(blob)
+        compiled = se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:
+        telemetry.counter("serve/manifest_stale_total").inc()
+        return None
+    telemetry.counter("serve/warm_hits_total").inc()
+    return compiled
+
+
+def default_manifest_dir(repo_root: Optional[str] = None) -> str:
+    """Rides next to the persistent XLA compile cache — the two layers
+    of the same warm-start story share a parent dir."""
+    from gansformer_tpu.utils.hostenv import compile_cache_env
+
+    env = compile_cache_env(repo_root)
+    return os.path.join(env["JAX_COMPILATION_CACHE_DIR"], "serve")

@@ -233,6 +233,29 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def _decode_leaf(arr: np.ndarray, t, path: str, k: str) -> jax.Array:
+    """One npz leaf validated against its template leaf and copied into
+    an XLA-owned buffer."""
+    t_shape = tuple(getattr(t, "shape", ()))
+    t_dtype = np.dtype(getattr(t, "dtype", arr.dtype))
+    if arr.dtype.kind == "V" and arr.dtype.itemsize == t_dtype.itemsize:
+        # extension dtypes (ml_dtypes bfloat16) round-trip through npz
+        # as raw void bytes — reinterpret them against the template's
+        # dtype (bit-exact)
+        arr = arr.view(t_dtype)
+    if tuple(arr.shape) != t_shape or arr.dtype != t_dtype:
+        raise ValueError(
+            f"checkpoint {path} leaf {k}: {arr.dtype}{arr.shape} "
+            f"does not match template {t_dtype}{t_shape}")
+    # jnp.array COPIES into an XLA-owned buffer.  Returning the raw
+    # numpy leaf invites heap corruption downstream: on the CPU backend
+    # device_put can zero-copy ALIAS a suitably aligned numpy buffer,
+    # and the train steps donate the state — XLA would then reuse/free
+    # memory owned by the Python allocator (observed as "corrupted
+    # double-linked list" on the first post-resume step).
+    return jnp.array(arr)
+
+
 def _restore_npz(path: str, template: TrainState) -> TrainState:
     t_leaves, treedef = jax.tree_util.tree_flatten(template)
     with np.load(path, allow_pickle=False) as z:
@@ -241,29 +264,48 @@ def _restore_npz(path: str, template: TrainState) -> TrainState:
             raise ValueError(
                 f"checkpoint {path} has {len(keys)} leaves, template has "
                 f"{len(t_leaves)} — config/model mismatch?")
-        out = []
-        for k, t in zip(keys, t_leaves):
-            arr = z[k]
-            t_shape = tuple(getattr(t, "shape", ()))
-            t_dtype = np.dtype(getattr(t, "dtype", arr.dtype))
-            if arr.dtype.kind == "V" and arr.dtype.itemsize == \
-                    t_dtype.itemsize:
-                # extension dtypes (ml_dtypes bfloat16) round-trip
-                # through npz as raw void bytes — reinterpret them
-                # against the template's dtype (bit-exact)
-                arr = arr.view(t_dtype)
-            if tuple(arr.shape) != t_shape or arr.dtype != t_dtype:
+        out = [_decode_leaf(z[k], t, path, k)
+               for k, t in zip(keys, t_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def restore_selected(ckpt_dir: str, template, select,
+                     step: Optional[int] = None):
+    """Partial restore: load ONLY the leaves whose pytree path satisfies
+    ``select(path) -> bool``; every other position restores as ``None``.
+
+    The serving path's checkpoint surface (ISSUE 10): a generation
+    service needs ``ema_params`` + ``w_avg`` and nothing else, and the
+    full-restore path forces the caller to materialize a CONCRETE
+    template — i.e. run the whole G+D+optimizer init just to throw most
+    of it away.  Here ``template`` may be an ABSTRACT TrainState
+    (``jax.eval_shape`` over ``create_train_state`` — no device work at
+    all); only the selected leaves are read from the npz, decoded, and
+    copied onto the device.  npz-format checkpoints only — legacy Orbax
+    step dirs (no ``state.npz``) raise ``FileNotFoundError`` so callers
+    can fall back to the full ``restore``.
+    """
+    step = step if step is not None else latest_step(ckpt_dir)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(ckpt_dir, str(step), STATE_FILE)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} missing — pre-npz (Orbax) checkpoint; use the full "
+            f"restore() with a concrete template")
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        template)
+    with span("ckpt/restore_selected") as sp:
+        with np.load(path, allow_pickle=False) as z:
+            keys = sorted(k for k in z.files if k.startswith("leaf_"))
+            if len(keys) != len(leaves_with_paths):
                 raise ValueError(
-                    f"checkpoint {path} leaf {k}: {arr.dtype}{arr.shape} "
-                    f"does not match template {t_dtype}{t_shape}")
-            # jnp.array COPIES into an XLA-owned buffer.  Returning the
-            # raw numpy leaf invites heap corruption downstream: on the
-            # CPU backend device_put can zero-copy ALIAS a suitably
-            # aligned numpy buffer, and the train steps donate the state
-            # — XLA would then reuse/free memory owned by the Python
-            # allocator (observed as "corrupted double-linked list" on
-            # the first post-resume step).
-            out.append(jnp.array(arr))
+                    f"checkpoint {path} has {len(keys)} leaves, template "
+                    f"has {len(leaves_with_paths)} — config/model "
+                    f"mismatch?")
+            out = [(_decode_leaf(z[k], t, path, k) if select(p) else None)
+                   for k, (p, t) in zip(keys, leaves_with_paths)]
+    telemetry.gauge("ckpt/restore_selected_ms").set(sp.duration_s * 1000.0)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

@@ -36,6 +36,75 @@ class BackgroundWriteError(RuntimeError):
     """A background writer job failed; ``__cause__`` is the original."""
 
 
+class LoopWorker:
+    """A long-lived background loop thread with the writer discipline.
+
+    ``SingleSlotWriter`` owns one-shot jobs; this owns a CONTINUOUS
+    loop (the serving dispatch loop, ISSUE 10) under the same failure
+    contract: the target runs once on its own thread (the target body
+    is the ``while``), an escaped exception is stored STICKY and
+    re-raised — wrapped in ``BackgroundWriteError`` — at EVERY later
+    ``poll()``, so every producer thread (request submitter) surfaces a
+    dead dispatcher within one call instead of blocking on tickets that
+    will never resolve.  Unlike ``SingleSlotWriter`` (one-shot jobs,
+    error delivered once then cleared), a dead continuous loop never
+    becomes healthy again — clearing on first delivery would let every
+    later submitter enqueue into a dead service.  Telemetry, per ``prefix``:
+    ``<prefix>_heartbeat`` gauge (last liveness touch — call
+    ``beat()`` from inside the loop), ``<prefix>_errors_total``.
+    """
+
+    def __init__(self, target: Callable[[], None], prefix: str):
+        self.prefix = prefix
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, args=(target,), name=f"{prefix}-loop",
+            daemon=True)
+
+    def _inst(self, kind: str, suffix: str):
+        from gansformer_tpu.obs import registry as telemetry
+
+        return getattr(telemetry, kind)(f"{self.prefix}{suffix}")
+
+    def start(self) -> "LoopWorker":
+        self._inst("gauge", "_heartbeat").set(time.time())
+        self._thread.start()
+        return self
+
+    def beat(self) -> None:
+        """Liveness touch — the loop body calls this per iteration so a
+        wedged dispatch is visible from telemetry.prom."""
+        self._inst("gauge", "_heartbeat").set(time.time())
+
+    def poll(self) -> None:
+        """Re-raise a loop crash — sticky forever: the loop is dead, so
+        every caller from now on must see it, not just the first."""
+        with self._lock:
+            err = self._error
+        if err is not None:
+            raise BackgroundWriteError(
+                f"{self.prefix} background loop died: "
+                f"{type(err).__name__}: {err}") from err
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def _run(self, target: Callable[[], None]) -> None:
+        try:
+            target()
+        except BaseException as e:  # noqa: BLE001 — re-raised via poll()
+            with self._lock:
+                self._error = e
+            self._inst("counter", "_errors_total").inc()
+        finally:
+            self._inst("gauge", "_heartbeat").set(time.time())
+
+
 class SingleSlotWriter:
     """Bounded (depth-1) background executor for writeback jobs."""
 

@@ -118,6 +118,27 @@ def default_stages():
               [py, "bench.py", "--scaling"],
               env={"GRAFT_SCALING_TIMEOUT": "700"},
               copies=[(".scaling_bench.json", "scaling_bench.json")]),
+        # 6b. Serving load test (ISSUE 10): the AOT generation service
+        #     under a Zipfian seed/ψ mix on the real flagship G
+        #     (random-init — serving PERFORMANCE needs the architecture,
+        #     not trained weights, and decoupling from the train stage
+        #     keeps the ledger's stages independent across windows).
+        #     Capture beats verdict: the script exits 0 whenever the
+        #     JSON lands; p50/p99 + img/s/chip + cold-vs-warm
+        #     first-image live in {win}/serve_loadtest.json.  Inner
+        #     bound: 300 requests / 600 s submit window under the 900 s
+        #     stage budget.  The manifest dir is PERSISTENT (repo root,
+        #     like .jax_compile_cache) so only the FIRST window pays
+        #     the flagship compiles — without it every window would
+        #     mkdtemp a fresh manifest and re-pay 6 × 30–100 s cold
+        #     compiles, busting the budget before the submit window.
+        stage("serve_loadtest", 900, "serve_loadtest_tpu.json",
+              [py, "scripts/loadtest_serve.py",
+               "--preset", "ffhq256-duplex", "--init", "random",
+               "--buckets", "1,4,8", "--requests", "300", "--rate", "8",
+               "--duration-s", "600",
+               "--manifest-dir", ".serve_manifest",
+               "--json-out", "{win}/serve_loadtest.json"]),
         # 7. Batch sweep (the optional throughput upside).
         stage("bench_sweep", 1800, "bench_sweep_tpu.json", [py, "bench.py"],
               env={"GRAFT_BENCH_TPU_TIMEOUT": "1500",

@@ -1,0 +1,255 @@
+"""Zipfian load test for the generation service (ISSUE 10).
+
+Models the millions-of-users request mix the ROADMAP names: seeds drawn
+from a bounded Zipf distribution (a few hot seeds and a long tail — the
+w-cache's natural prey), ψ from a small Zipf-weighted menu, Poisson
+arrivals at ``--rate``.  Reports what a TPU serving comparison must
+report (the Gemma-on-TPU paper's axes, PAPERS.md): p50/p99 end-to-end
+latency, img/s and img/s/chip under load, batch fill, and cold-vs-warm
+first-image time (the warm-start manifest's whole value proposition).
+
+Capture beats verdict (the battery discipline): the script exits 0
+whenever the JSON artifact is written — SLO verdicts live IN the
+artifact (``prom_ok``, the latency table), never in the exit code, so a
+slow window still banks its numbers.
+
+    python scripts/loadtest_serve.py --tiny --requests 64 --json-out out.json
+    python scripts/loadtest_serve.py --preset ffhq256-duplex --init random \
+        --buckets 1,4,8 --requests 300 --rate 8 --duration-s 300 \
+        --json-out serve_loadtest.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    i = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[i])
+
+
+def zipf_choice(rng, universe, size, s: float):
+    """Bounded ranked-Zipf draw: p(rank i) ∝ 1/(i+1)^s."""
+    import numpy as np
+
+    p = 1.0 / np.arange(1, len(universe) + 1, dtype=np.float64) ** s
+    return rng.choice(universe, size=size, p=p / p.sum())
+
+
+def run_loadtest(bundle, buckets, requests, rate, duration_s,
+                 zipf_s=1.1, seed_universe=512, manifest_dir=None,
+                 psis=(0.7, 0.5, 1.0, 0.8), fill_wait_ms=2.0,
+                 wcache=4096, seed=0, measure_cold=True):
+    """Drive a GenerationService; returns the result dict (pure of
+    argparse/IO so tests call it directly)."""
+    import jax
+    import numpy as np
+
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import GenerationService, ServePrograms
+
+    rng = np.random.RandomState(seed)
+    result = {"buckets": list(buckets), "zipf_s": zipf_s,
+              "seed_universe": seed_universe, "psi_menu": list(psis),
+              "rate_rps": rate,
+              "device": {"platform": jax.devices()[0].platform,
+                         "kind": jax.devices()[0].device_kind,
+                         "count": len(jax.devices())}}
+
+    def first_image_ms(programs) -> float:
+        with GenerationService(programs, max_fill_wait_ms=0.0,
+                               wcache_capacity=0) as svc:
+            t0 = time.perf_counter()
+            svc.submit(int(rng.randint(1 << 20)), psi=0.7).result(
+                timeout=1200)
+            return (time.perf_counter() - t0) * 1000.0
+
+    # -- cold vs warm first image -------------------------------------------
+    if measure_cold:
+        cold = ServePrograms(bundle, buckets=buckets,
+                             manifest_dir=manifest_dir)
+        t0 = time.perf_counter()
+        cold_warmup = cold.warm_start()
+        result["cold_build_s"] = round(time.perf_counter() - t0, 3)
+        result["cold_first_image_ms"] = round(first_image_ms(cold), 1)
+        result["cold_compiles"] = cold_warmup["compiled"]
+    programs = ServePrograms(bundle, buckets=buckets,
+                             manifest_dir=manifest_dir)
+    t0 = time.perf_counter()
+    warm_stats = programs.warm_start()
+    result["warm_build_s"] = round(time.perf_counter() - t0, 3)
+    result["warm_first_image_ms"] = round(first_image_ms(programs), 1)
+    result["warm_start"] = {k: (round(v, 3) if k == "seconds" else v)
+                            for k, v in warm_stats.items()}
+    # time-to-first-image from a bare process: build (compile vs
+    # deserialize) + one dispatch — THE cold/warm headline pair
+    if measure_cold:
+        result["cold_first_image_total_ms"] = round(
+            result["cold_build_s"] * 1000.0
+            + result["cold_first_image_ms"], 1)
+    result["warm_first_image_total_ms"] = round(
+        result["warm_build_s"] * 1000.0 + result["warm_first_image_ms"], 1)
+
+    # -- the load run -------------------------------------------------------
+    seeds = zipf_choice(rng, np.arange(1, seed_universe + 1), requests,
+                        zipf_s)
+    psi_mix = zipf_choice(rng, np.asarray(psis, np.float64), requests, 1.0)
+    gaps = rng.exponential(1.0 / max(rate, 1e-9), size=requests) \
+        if rate > 0 else np.zeros(requests)
+
+    tickets = []
+    t_start = time.perf_counter()
+    with GenerationService(programs, max_fill_wait_ms=fill_wait_ms,
+                           wcache_capacity=wcache) as svc:
+        for i in range(requests):
+            if time.perf_counter() - t_start > duration_s:
+                break
+            tickets.append(svc.submit(int(seeds[i]),
+                                      psi=float(psi_mix[i])))
+            if rate > 0:
+                time.sleep(float(gaps[i]))
+        images = [t.result(timeout=max(60.0, duration_s)) for t in tickets]
+    wall_s = time.perf_counter() - t_start
+
+    lats = sorted(t.latency_ms for t in tickets)
+    n_chips = len(jax.devices())
+    snap = telemetry.get_registry().snapshot()
+    fill = snap["histograms"].get("serve/batch_fill", {})
+    depth = snap["histograms"].get("serve/queue_depth", {})
+    hits = snap["counters"].get("serve/wcache_hits_total", 0.0)
+    misses = snap["counters"].get("serve/wcache_misses_total", 0.0)
+    result.update({
+        "requests": len(tickets),
+        "images": len(images),
+        "duration_s": round(wall_s, 3),
+        "p50_ms": round(percentile(lats, 50), 2),
+        "p90_ms": round(percentile(lats, 90), 2),
+        "p99_ms": round(percentile(lats, 99), 2),
+        "mean_ms": round(float(sum(lats)) / max(len(lats), 1), 2),
+        "img_per_s": round(len(images) / max(wall_s, 1e-9), 2),
+        "img_per_s_per_chip": round(
+            len(images) / max(wall_s, 1e-9) / n_chips, 2),
+        "batch_fill_mean": round(fill.get("mean", 0.0), 4),
+        "queue_depth_mean": round(depth.get("mean", 0.0), 2),
+        "queue_depth_max": depth.get("max"),
+        "wcache_hit_rate": round(hits / max(hits + misses, 1.0), 4),
+        "map_dispatch_total": snap["counters"].get(
+            "serve/map_dispatch_total", 0.0),
+        "synth_dispatch_total": snap["counters"].get(
+            "serve/synth_dispatch_total", 0.0),
+    })
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Zipfian load test for the generation service")
+    p.add_argument("--run-dir", default=None,
+                   help="serve a real checkpoint (G-only restore)")
+    p.add_argument("--preset", default=None)
+    p.add_argument("--init", default="random",
+                   choices=("checkpoint", "random"))
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny 16×16 trace-config model — the CPU proxy")
+    p.add_argument("--buckets", default="1,4,8")
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--rate", type=float, default=20.0,
+                   help="Poisson arrival rate, req/s (0 = back-to-back)")
+    p.add_argument("--duration-s", type=float, default=300.0,
+                   help="hard wall bound on the submit window")
+    p.add_argument("--zipf-s", type=float, default=1.1)
+    p.add_argument("--seed-universe", type=int, default=512)
+    p.add_argument("--fill-wait-ms", type=float, default=2.0)
+    p.add_argument("--wcache", type=int, default=4096)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--manifest-dir", default=None,
+                   help="warm-start manifest dir ('' disables; default: a "
+                        "fresh temp dir so cold-vs-warm is honest)")
+    p.add_argument("--json-out", default=None)
+    p.add_argument("--prom-out", default=None,
+                   help="also write telemetry.prom here (default: next to "
+                        "--json-out)")
+    args = p.parse_args(argv)
+
+    from gansformer_tpu.obs import install_compile_listener
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import init_generator, load_generator
+    from gansformer_tpu.utils.hostenv import enable_compile_cache
+
+    enable_compile_cache()
+    install_compile_listener()
+
+    if args.tiny:
+        from gansformer_tpu.analysis.trace.entry_points import tiny_config
+
+        bundle = init_generator(tiny_config("float32"), seed=args.seed)
+    elif args.init == "checkpoint":
+        if not args.run_dir:
+            raise SystemExit("--init checkpoint needs --run-dir")
+        from gansformer_tpu.utils.runarchive import resolve_run_dir
+
+        bundle = load_generator(resolve_run_dir(args.run_dir))
+    else:
+        if not args.preset:
+            raise SystemExit("--init random needs --preset (or --tiny)")
+        from gansformer_tpu.core.config import get_preset
+
+        bundle = init_generator(get_preset(args.preset).validate(),
+                                seed=args.seed)
+
+    if args.manifest_dir == "":
+        manifest_dir = None
+    elif args.manifest_dir is None:
+        import tempfile
+
+        manifest_dir = tempfile.mkdtemp(prefix="serve_manifest_")
+    else:
+        manifest_dir = args.manifest_dir
+
+    result = run_loadtest(
+        bundle, tuple(int(b) for b in args.buckets.split(",")),
+        requests=args.requests, rate=args.rate,
+        duration_s=args.duration_s, zipf_s=args.zipf_s,
+        seed_universe=args.seed_universe, manifest_dir=manifest_dir,
+        fill_wait_ms=args.fill_wait_ms, wcache=args.wcache,
+        seed=args.seed)
+
+    # telemetry.prom + the schema lint's serve-family check: the SLO
+    # histograms must be PRESENT and well-formed, verdict in-artifact
+    prom_path = args.prom_out or (
+        os.path.join(os.path.dirname(os.path.abspath(args.json_out)),
+                     "telemetry.prom") if args.json_out else None)
+    if prom_path:
+        from gansformer_tpu.analysis.telemetry_schema import (
+            check_prom, check_serve_metric_families)
+
+        telemetry.get_registry().write_prom(prom_path)
+        errors = check_prom(prom_path) + \
+            check_serve_metric_families(prom_path)
+        result["prom"] = prom_path
+        result["prom_ok"] = not errors
+        result["prom_errors"] = errors
+
+    blob = json.dumps(result, indent=1, sort_keys=True)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(blob + "\n")
+    print(blob)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -264,6 +264,31 @@ def test_rule_baselined(rule_id, bad, ok, tmp_path):
 
 # --- rule-specific edge cases ----------------------------------------------
 
+def test_hot_loop_covers_serve_dispatch_loop():
+    """ISSUE 10: the serving dispatch loop joins the hot-loop-sync
+    discipline — its sanctioned span is ``serve_fetch`` (NOT the train
+    loop's ``tick_fetch``), and syncs outside it are findings."""
+    bad = """
+def _serve_dispatch(self):
+    while True:
+        ws = jax.device_get(dev)
+        with span("serve_fetch"):
+            imgs = jax.device_get(out)      # sanctioned
+        with span("tick_fetch"):
+            other = jax.device_get(out)     # WRONG loop's span
+"""
+    findings = run_rule("hot-loop-sync", bad)
+    assert len(findings) == 2
+    assert all("serve_fetch" in f.message for f in findings)
+    ok = """
+def _serve_dispatch(self):
+    while True:
+        with span("serve_fetch"):
+            ws = jax.device_get(dev)
+"""
+    assert run_rule("hot-loop-sync", ok) == []
+
+
 def test_host_sync_item_and_np_asarray_taint():
     src = """
 import jax

@@ -97,6 +97,27 @@ def test_graftcomms_stage_captures_tpu_comms_table():
     assert "--json-out {win}/comms_diff.json" in argv
 
 
+def test_serve_loadtest_stage_banks_slo_artifact():
+    """ISSUE 10 satellite: the battery load-tests the generation
+    service on TPU — Zipfian mix on the flagship architecture
+    (random-init: serving PERFORMANCE needs the model, not trained
+    weights), artifact into the window ledger, submit window bounded
+    under the stage budget."""
+    stages = {s["name"]: s for s in battery.default_stages()}
+    st = stages["serve_loadtest"]
+    argv = " ".join(st["argv"])
+    assert "scripts/loadtest_serve.py" in argv
+    assert "--json-out {win}/serve_loadtest.json" in argv
+    assert "--init random" in argv and "--preset" in argv
+    assert "--duration-s 600" in argv          # + compile headroom
+    assert st["budget_s"] >= 600 + 150
+    # persistent manifest: only the FIRST window pays flagship
+    # compiles; a per-window tempdir would bust the budget every time
+    assert "--manifest-dir .serve_manifest" in argv
+    names = [s["name"] for s in battery.default_stages()]
+    assert names.index("serve_loadtest") < names.index("bench_sweep")
+
+
 def test_scaling_stage_runs_bench_scaling():
     """ISSUE 7: the battery measures scaling efficiency on real chips —
     bench.py --scaling before the optional sweep, stable artifact copy

@@ -304,6 +304,39 @@ def test_generate_cli_grid_and_interpolation(tmp_path, micro_run_dir):
     assert grid.size and interp.std() > 0 and mix.std() > 0
 
 
+def test_serve_cli_warm_start_zero_compiles(tmp_path, micro_run_dir,
+                                            capsys):
+    """ISSUE 10 acceptance (CPU proxy): ``gansformer-serve`` against a
+    real checkpoint — G-only restore, AOT programs, demo traffic — and
+    a SECOND invocation against the populated manifest reaches first
+    image with ZERO new program compiles.  Its telemetry.prom passes
+    the serve-family schema lint."""
+    import os
+
+    from gansformer_tpu.analysis.telemetry_schema import (
+        check_serve_metric_families)
+    from gansformer_tpu.cli.serve import main as serve
+
+    md = str(tmp_path / "manifest")
+    out = str(tmp_path / "served")
+    args = ["--run-dir", micro_run_dir, "--buckets", "1,2",
+            "--images", "3", "--manifest-dir", md, "--out", out]
+    serve(args)
+    first = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert first["warm_start"]["compiled"] == 4          # 2 kinds × 2
+    assert first["first_image_ms"] > 0
+
+    serve(args)
+    second = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert second["warm_start"] == {"compiled": 0, "loaded": 4,
+                                    "seconds": second["warm_start"]
+                                    ["seconds"]}
+    assert second["first_image_ms"] > 0
+    assert os.path.exists(os.path.join(out, "served_grid.png"))
+    prom = os.path.join(out, "telemetry.prom")
+    assert check_serve_metric_families(prom) == []
+
+
 def test_config_validate_messages():
     """ExperimentConfig.validate fails fast with named errors instead of
     deep trace-time asserts (SURVEY.md §5 config row)."""

@@ -1,0 +1,413 @@
+"""Serving subsystem tests (ISSUE 10): the split AOT programs, the
+bucketed-padding parity contract, the serialized-executable warm start,
+the w-cache, and the continuous-batching service.
+
+The load-bearing contracts, each pinned here:
+
+* bucket selection picks the smallest bucket ≥ n and refuses oversize
+  batches (the service chunks at max-bucket instead);
+* a request batch padded up to the next bucket produces BIT-IDENTICAL
+  images to the unpadded batch prefix, f32 and bf16 — held by per-row
+  noise keys in ``serve_synth`` (a batch-shaped draw from one key would
+  make row i depend on the bucket);
+* a second process start with a populated manifest compiles ZERO
+  programs (``compile/compiles_total`` delta via the existing listener)
+  and corrupt/stale manifest entries fall back to recompile;
+* a repeat-seed request never dispatches the mapping program
+  (``serve/map_dispatch_total`` stays flat — the acceptance counter);
+* a dead dispatcher surfaces at ``submit()`` (LoopWorker discipline),
+  not as a hang.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def _tiny_bundle(dtype="float32"):
+    from gansformer_tpu.analysis.trace.entry_points import tiny_config
+    from gansformer_tpu.serve import init_generator
+
+    return init_generator(tiny_config(dtype))
+
+
+def _noisy(bundle):
+    """A bundle whose noise layers CONTRIBUTE (random init zeroes
+    ``noise_strength``, which would make padding parity trivially true
+    regardless of how noise is drawn) and whose w_avg is a real anchor
+    (zero would make truncation a pure scale)."""
+    import jax
+    import jax.numpy as jnp
+
+    def bump(path, leaf):
+        name = str(getattr(path[-1], "name", getattr(path[-1], "key", "")))
+        return jnp.full_like(leaf, 0.1) if name == "noise_strength" \
+            else leaf
+
+    w_avg = jnp.asarray(np.random.RandomState(0).normal(
+        size=bundle.w_avg.shape), jnp.float32)
+    return dataclasses.replace(
+        bundle,
+        ema_params=jax.tree_util.tree_map_with_path(bump,
+                                                    bundle.ema_params),
+        w_avg=w_avg)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return _tiny_bundle()
+
+
+@pytest.fixture(scope="module")
+def programs(bundle):
+    """Shared compiled programs (no manifest — warm-start behavior has
+    its own tmp-dir test) so the service/w-cache tests pay the tiny
+    compiles once."""
+    from gansformer_tpu.serve import ServePrograms
+
+    return ServePrograms(bundle, buckets=(1, 2, 4), manifest_dir=None)
+
+
+# -- bucket selection --------------------------------------------------------
+
+def test_bucket_selection_edges():
+    """Smallest bucket ≥ n, covered at 1 / bucket / bucket+1 /
+    oversize / invalid — the edges the padding path lives on."""
+    from gansformer_tpu.serve import bucket_for
+    from gansformer_tpu.serve.programs import sorted_buckets
+
+    buckets = sorted_buckets([8, 1, 4, 4])
+    assert buckets == (1, 4, 8)
+    assert bucket_for(1, buckets) == 1
+    assert bucket_for(2, buckets) == 4      # bucket-1 + 1
+    assert bucket_for(4, buckets) == 4      # exactly a bucket
+    assert bucket_for(5, buckets) == 8      # bucket + 1
+    assert bucket_for(8, buckets) == 8
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        bucket_for(9, buckets)
+    with pytest.raises(ValueError, match="n >= 1"):
+        bucket_for(0, buckets)
+    with pytest.raises(ValueError, match="positive"):
+        sorted_buckets([0, 2])
+    with pytest.raises(ValueError, match="positive"):
+        sorted_buckets([])
+
+
+# -- bucketed-padding parity -------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_padding_parity_bit_identical(dtype):
+    """A batch padded up to the next bucket produces BIT-identical
+    images to the unpadded batch prefix — the contract that lets the
+    service pad freely.  Noise strengths are forced non-zero so the
+    per-row noise keys are actually exercised."""
+    from gansformer_tpu.serve import ServePrograms
+
+    b = _noisy(_tiny_bundle(dtype))
+    p = ServePrograms(b, buckets=(2, 4), manifest_dir=None)
+    rng = np.array([3, 9], np.uint32)
+
+    ws2 = np.asarray(p.map_seeds(np.array([11, 12], np.int32)))
+    ws4 = np.asarray(p.map_seeds(np.array([11, 12, 12, 12], np.int32)))
+    assert (ws4[:2] == ws2).all(), "mapping rows depend on the bucket"
+
+    img2 = np.asarray(p.synthesize(
+        ws2, np.array([0.6, 0.9], np.float32), rng))
+    img4 = np.asarray(p.synthesize(
+        ws4, np.array([0.6, 0.9, 1.0, 1.0], np.float32), rng))
+    assert img2.dtype == img4.dtype
+    assert (img4[:2] == img2).all(), \
+        f"{dtype}: padded prefix differs from the unpadded batch"
+
+
+def test_programs_refuse_partial_buckets(programs):
+    """The dispatch layer owns padding; the program layer refuses a
+    non-bucket batch instead of silently recompiling a new shape."""
+    with pytest.raises(ValueError, match="full bucket"):
+        programs.map_seeds(np.array([1, 2, 3], np.int32))
+    with pytest.raises(ValueError, match="full bucket"):
+        programs.synthesize(
+            np.zeros((3, programs.bundle.cfg.model.num_ws,
+                      programs.bundle.cfg.model.w_dim), np.float32),
+            np.ones((3,), np.float32), np.array([0, 1], np.uint32))
+
+
+# -- warm start --------------------------------------------------------------
+
+def test_warm_start_second_process_compiles_zero(tmp_path, bundle):
+    """The ISSUE 10 acceptance pair: a fresh ``ServePrograms`` against a
+    populated manifest deserializes every executable — zero program
+    compiles AND zero XLA compiles by the existing registry counter —
+    and still serves a correct image."""
+    from gansformer_tpu import obs
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import ServePrograms
+
+    obs.install_compile_listener()
+    md = str(tmp_path / "manifest")
+    cold = ServePrograms(bundle, buckets=(1,), manifest_dir=md)
+    w1 = cold.warm_start()
+    assert w1["compiled"] == 2 and w1["loaded"] == 0   # map + synth
+    assert os.path.exists(os.path.join(md, "manifest.json"))
+
+    imgs_cold = np.asarray(cold.synthesize(
+        np.asarray(cold.map_seeds(np.array([5], np.int32))),
+        np.array([0.7], np.float32), np.array([0, 1], np.uint32)))
+
+    before = telemetry.counter("compile/compiles_total").value
+    warm = ServePrograms(bundle, buckets=(1,), manifest_dir=md)
+    w2 = warm.warm_start()
+    imgs_warm = np.asarray(warm.synthesize(
+        np.asarray(warm.map_seeds(np.array([5], np.int32))),
+        np.array([0.7], np.float32), np.array([0, 1], np.uint32)))
+    assert w2 == {"loaded": 2, "compiled": 0, "seconds": w2["seconds"]}
+    assert telemetry.counter("compile/compiles_total").value == before, \
+        "warm start triggered an XLA compile"
+    assert (imgs_warm == imgs_cold).all()   # deserialized program parity
+
+
+def test_warm_start_corrupt_entries_fall_back(tmp_path, bundle):
+    """Corrupt/stale manifest entries recompile instead of crashing:
+    torn executable bytes, a tampered fingerprint, and a garbage
+    manifest.json each land on the fallback path (counted stale)."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import ServePrograms
+
+    md = str(tmp_path / "manifest")
+    ServePrograms(bundle, buckets=(1,), manifest_dir=md).warm_start()
+
+    # torn bytes under a valid manifest entry
+    victim = os.path.join(md, "map_seeds_b1.bin")
+    with open(victim, "r+b") as f:
+        f.write(b"\x00garbage\x00")
+    stale0 = telemetry.counter("serve/manifest_stale_total").value
+    p = ServePrograms(bundle, buckets=(1,), manifest_dir=md)
+    w = p.warm_start()
+    assert w["compiled"] == 1 and w["loaded"] == 1
+    assert telemetry.counter("serve/manifest_stale_total").value > stale0
+
+    # stale fingerprint (architecture/runtime drift)
+    mpath = os.path.join(md, "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["entries"]["synthesize_b1"]["fingerprint"] = "deadbeef"
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    w = ServePrograms(bundle, buckets=(1,), manifest_dir=md).warm_start()
+    assert w["compiled"] == 1 and w["loaded"] == 1    # rewritten above
+
+    # garbage manifest.json: start over, no crash
+    with open(mpath, "w") as f:
+        f.write("{not json")
+    w = ServePrograms(bundle, buckets=(1,), manifest_dir=md).warm_start()
+    assert w["compiled"] == 2 and w["loaded"] == 0
+
+
+# -- w-cache -----------------------------------------------------------------
+
+def test_wcache_lru_eviction_and_keys():
+    from gansformer_tpu.serve import WCache, wcache_key
+
+    c = WCache(capacity=2)
+    k1, k2, k3 = (wcache_key(i, None) for i in (1, 2, 3))
+    c.put(k1, np.zeros(1)), c.put(k2, np.ones(1))
+    assert c.get(k1) is not None          # touch 1 → 2 becomes LRU
+    c.put(k3, np.full(1, 3.0))
+    assert len(c) == 2 and c.get(k2) is None and c.get(k3) is not None
+    # labels distinguish keys; identical content hits
+    la = wcache_key(7, np.array([1.0, 0.0], np.float32))
+    assert la == wcache_key(7, np.array([1.0, 0.0], np.float32))
+    assert la != wcache_key(7, np.array([0.0, 1.0], np.float32))
+    assert WCache(0).get(k1) is None      # capacity-0 = disabled
+
+
+def test_repeat_seed_skips_mapping_program(programs):
+    """THE acceptance counter: on the cache-hit path the mapping program
+    dispatches ZERO times — including at a different ψ (the cache is
+    ψ-independent because truncation lives in the synthesis program)."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import GenerationService
+
+    with GenerationService(programs, max_fill_wait_ms=0.0) as svc:
+        first = svc.submit(991, psi=0.7).result(timeout=60)
+        maps = telemetry.counter("serve/map_dispatch_total").value
+        hits = telemetry.counter("serve/wcache_hits_total").value
+        again = svc.submit(991, psi=0.7).result(timeout=60)
+        other_psi = svc.submit(991, psi=0.4).result(timeout=60)
+        assert telemetry.counter("serve/map_dispatch_total").value == maps
+        assert telemetry.counter("serve/wcache_hits_total").value == \
+            hits + 2
+    assert (again == first).all()          # same seed+ψ, same noise seed
+    assert first.shape == other_psi.shape and not (other_psi == first).all()
+
+
+def test_partial_miss_batch_maps_once(programs):
+    """A batch mixing cache hits and misses takes the assemble-on-host
+    path: exactly one mapping dispatch for the misses, every ticket
+    still resolves."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import GenerationService
+
+    with GenerationService(programs, max_fill_wait_ms=200.0) as svc:
+        svc.submit(700).result(timeout=60)            # cache seed 700
+        maps = telemetry.counter("serve/map_dispatch_total").value
+        t1, t2 = svc.submit(700), svc.submit(701)     # hit + miss
+        a, b = t1.result(timeout=60), t2.result(timeout=60)
+    assert np.isfinite(np.float32(a)).all()
+    assert np.isfinite(np.float32(b)).all()
+    assert telemetry.counter("serve/map_dispatch_total").value == maps + 1
+
+
+# -- the service -------------------------------------------------------------
+
+def test_service_serves_a_burst_with_slo_telemetry(programs, tmp_path):
+    """A burst through the continuous-batching queue: every ticket
+    resolves, the SLO histograms/counters land, and telemetry.prom
+    passes the serve-family schema lint."""
+    from gansformer_tpu.analysis.telemetry_schema import (
+        check_prom, check_serve_metric_families)
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import GenerationService
+
+    reg = telemetry.get_registry()
+    e2e0 = reg.histogram("serve/e2e_ms").count
+    imgs0 = telemetry.counter("serve/images_total").value
+    with GenerationService(programs, max_fill_wait_ms=20.0) as svc:
+        tickets = [svc.submit(seed, psi=0.5 + 0.1 * (seed % 3))
+                   for seed in range(30, 39)]
+        images = [t.result(timeout=60) for t in tickets]
+    m = programs.bundle.cfg.model
+    assert all(i.shape == (m.resolution, m.resolution, m.img_channels)
+               for i in images)
+    assert all(np.isfinite(np.float32(i)).all() for i in images)
+    assert reg.histogram("serve/e2e_ms").count == e2e0 + 9
+    assert telemetry.counter("serve/images_total").value == imgs0 + 9
+    assert reg.histogram("serve/queue_depth").count > 0
+    fill = reg.histogram("serve/batch_fill")
+    assert fill.count > 0 and 0.0 < fill.max <= 1.0
+    assert all(t.latency_ms is not None and t.latency_ms > 0
+               for t in tickets)
+
+    prom = str(tmp_path / "telemetry.prom")
+    reg.write_prom(prom)
+    assert check_prom(prom) == []
+    assert check_serve_metric_families(prom) == []
+
+
+def test_dead_dispatcher_surfaces_at_submit(bundle):
+    """LoopWorker discipline: a dispatcher crash fails the in-flight
+    tickets AND re-raises at the next ``submit`` — never a silent
+    hang."""
+    from gansformer_tpu.serve import GenerationService, ServePrograms
+    from gansformer_tpu.utils.background import BackgroundWriteError
+
+    class Boom(ServePrograms):
+        def map_seeds(self, seeds, label=None):
+            raise RuntimeError("device on fire")
+
+    svc = GenerationService(Boom(bundle, buckets=(1,), manifest_dir=None),
+                            max_fill_wait_ms=0.0)
+    t = svc.submit(1)
+    with pytest.raises(RuntimeError, match="generation request failed"):
+        t.result(timeout=30)
+    svc._worker.join(30)
+    # sticky FOREVER: a dead loop never recovers, so every later
+    # submitter must see the crash — not just the first one
+    for _ in range(2):
+        with pytest.raises(BackgroundWriteError, match="dispatch"):
+            svc.submit(2)
+    svc.close()
+
+
+def test_service_close_fails_queued_tickets(programs):
+    """Tickets still queued at close() resolve with an error, not a
+    hang."""
+    from gansformer_tpu.serve import GenerationService
+
+    svc = GenerationService(programs, max_fill_wait_ms=0.0)
+    svc.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        svc.submit(1)
+
+
+# -- the load-test harness ---------------------------------------------------
+
+def test_run_loadtest_smoke(bundle):
+    """``run_loadtest`` end-to-end on the tiny CPU proxy: the artifact
+    carries the whole reporting contract — latency percentiles,
+    throughput per chip, batch fill, warm-start + first-image split —
+    with coherent values."""
+    from scripts.loadtest_serve import run_loadtest
+
+    r = run_loadtest(bundle, (1, 2), requests=12, rate=0.0,
+                     duration_s=60.0, manifest_dir=None, wcache=64,
+                     seed_universe=8, measure_cold=False)
+    assert r["requests"] == 12 and r["images"] == 12
+    for k in ("p50_ms", "p90_ms", "p99_ms", "img_per_s",
+              "img_per_s_per_chip", "batch_fill_mean",
+              "warm_first_image_total_ms", "wcache_hit_rate"):
+        assert np.isfinite(r[k]), (k, r[k])
+    assert r["p50_ms"] <= r["p99_ms"]
+    assert 0.0 <= r["wcache_hit_rate"] <= 1.0
+    assert r["synth_dispatch_total"] > 0
+    # Zipf over an 8-seed universe with 12 draws must repeat seeds —
+    # the w-cache sees hits
+    assert r["wcache_hit_rate"] > 0.0
+
+
+# -- the G-only checkpoint surface -------------------------------------------
+
+def test_restore_selected_partial_restore(micro_run_dir):
+    """``restore_selected`` against an ABSTRACT template loads exactly
+    the selected leaves (== the full restore's values) and leaves the
+    rest None — the discriminator and optimizer are never materialized."""
+    import jax
+
+    from gansformer_tpu.core.config import ExperimentConfig
+    from gansformer_tpu.parallel.contracts import key_str
+    from gansformer_tpu.train import checkpoint as ckpt
+    from gansformer_tpu.train.state import create_train_state
+
+    with open(os.path.join(micro_run_dir, "config.json")) as f:
+        cfg = ExperimentConfig.from_json(f.read())
+    ckpt_dir = os.path.join(micro_run_dir, "checkpoints")
+    template = jax.eval_shape(lambda k: create_train_state(cfg, k),
+                              jax.random.PRNGKey(0))
+
+    def is_g(path):
+        return key_str(path[0]) in ("ema_params", "w_avg") if path \
+            else False
+
+    part = ckpt.restore_selected(ckpt_dir, template, is_g)
+
+    def all_none(tree):   # unselected POSITIONS restore as None leaves
+        leaves = jax.tree_util.tree_flatten(
+            tree, is_leaf=lambda x: x is None)[0]
+        return bool(leaves) and all(l is None for l in leaves)
+
+    assert all_none(part.d_params) and all_none(part.g_opt) \
+        and all_none(part.d_opt)
+    full = ckpt.restore(ckpt_dir,
+                        create_train_state(cfg, jax.random.PRNGKey(0)))
+    assert (np.asarray(part.w_avg) == np.asarray(full.w_avg)).all()
+    pl, fl = (jax.tree_util.tree_leaves(t.ema_params) for t in (part,
+                                                                full))
+    assert len(pl) == len(fl)
+    assert all((np.asarray(a) == np.asarray(b)).all()
+               for a, b in zip(pl, fl))
+
+
+def test_load_generator_bundle_matches_checkpoint(micro_run_dir):
+    """``load_generator`` (the serve/generate CLI surface) returns the
+    checkpoint's EMA generator and records its restore cost."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import load_generator
+
+    b = load_generator(micro_run_dir)
+    assert b.cfg.model.resolution == 16
+    assert np.asarray(b.w_avg).shape == (b.cfg.model.w_dim,)
+    assert np.isfinite(np.asarray(b.w_avg)).all()
+    assert telemetry.gauge("serve/restore_ms").value > 0

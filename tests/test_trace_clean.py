@@ -79,14 +79,17 @@ def test_real_train_step_compiles_exactly_once():
 
 def test_fast_matrix_covers_at_least_four_entry_points():
     """``gansformer-lint --trace`` traces ≥ 4 real entry points
-    (acceptance floor) — and the fused cycle program is among them."""
+    (acceptance floor) — the fused cycle program is among them, and
+    since ISSUE 10 so is the serving split (map + synth)."""
     from gansformer_tpu.analysis.trace.entry_points import build_matrix
 
     eps = build_matrix("fast")
     shorts = {ep.name.split(".")[1].split("[")[0] for ep in eps}
     assert len(eps) >= 4
-    assert {"d_step", "g_step", "cycle", "sample"} <= shorts
-    assert all(ep.path.endswith("train/steps.py") for ep in eps)
+    assert {"d_step", "g_step", "cycle", "sample",
+            "serve_map_seeds", "serve_synth"} <= shorts
+    assert all(ep.path.endswith(("train/steps.py", "serve/programs.py"))
+               for ep in eps)
 
 
 def test_cycle_it0_flavor_pinned_at_jit_boundary():
@@ -145,6 +148,32 @@ def test_g_step_all_reduces_on_two_device_mesh():
     assert rec["entry"] == "steps.g_step[tiny-f32]"
     assert rec["collectives"].get("all-reduce", {}).get("count", 0) >= 1, \
         "g_step compiled to zero all-reduces — replicated compute"
+
+
+def test_serve_entries_graftcomms_clean():
+    """ISSUE 10 satellite: partition-contract + collective-flow stay
+    CLEAN (zero non-baselined findings, zero skip-notes) over the
+    serving split programs on the simulated 2-device mesh — the AOT
+    executables the service dispatches must honor the declared layout
+    (params replicated, request rows on ``data``)."""
+    from gansformer_tpu.analysis.trace.collective_flow import (
+        CollectiveFlowRule)
+    from gansformer_tpu.analysis.trace.entry_points import (
+        build_serve_entry_points)
+    from gansformer_tpu.analysis.trace.harness import run_trace
+    from gansformer_tpu.analysis.trace.partition_contract import (
+        PartitionContractRule)
+
+    eps = build_serve_entry_points(
+        include=["serve_map_seeds", "serve_synth"])
+    assert [ep.name for ep in eps] == [
+        "serve.serve_map_seeds[tiny-f32]", "serve.serve_synth[tiny-f32]"]
+    findings, ctx = run_trace(
+        "fast", rules=[PartitionContractRule, CollectiveFlowRule],
+        entries=eps, mesh_sizes=(2,))
+    _assert_no_new(_apply_baseline(findings))
+    assert not ctx.notes, ctx.notes     # compiled, audited, not skipped
+    assert {c["entry"] for c in ctx.comms} == {ep.name for ep in eps}
 
 
 def test_fast_matrix_has_pallas_backend_member():
