@@ -176,6 +176,86 @@ def check_serve_metric_families(path: str) -> List[str]:
     return errors
 
 
+def check_supervise_metric_families(path: str) -> List[str]:
+    """Supervisor availability families (ISSUE 12): a run supervised by
+    ``gansformer-supervise`` writes ``supervisor.prom``, and the whole
+    family is materialized at supervisor start — absence of any member
+    means the wiring rotted, never "nothing happened yet" (the same
+    explicit-marker discipline as the device-truth check).  Values-aware:
+    the per-cause counters must sum to the exit total."""
+    from gansformer_tpu.obs.registry import parse_prom_values
+
+    vals = parse_prom_values(path)
+    errors = []
+    members = ("supervise_restarts_total", "supervise_exits_total",
+               "supervise_clean_exits_total", "supervise_crashes_total",
+               "supervise_preemptions_total", "supervise_hangs_total",
+               "supervise_availability_ratio",
+               "supervise_uptime_s_total", "supervise_downtime_s_total",
+               "supervise_restart_budget_remaining")
+    for name in members:
+        if name not in vals:
+            errors.append(f"{path}: missing supervise/* family member "
+                          f"{name} (is the supervisor telemetry wired?)")
+    total = vals.get("supervise_exits_total")
+    by_cause = [vals.get(f"supervise_{c}", 0.0)
+                for c in ("clean_exits_total", "crashes_total",
+                          "preemptions_total", "hangs_total")]
+    if total is not None and sum(by_cause) != total:
+        errors.append(f"{path}: per-cause exit counters sum to "
+                      f"{sum(by_cause):g} but supervise_exits_total is "
+                      f"{total:g} — an exit went unclassified")
+    return errors
+
+
+SUPERVISOR_EVENT_KEYS = {"kind": str, "time": (int, float), "pid": int}
+
+
+def check_supervisor_events(path: str) -> List[str]:
+    """``supervisor_events.jsonl`` schema: every line a JSON object with
+    kind/time/pid; exit events carry a cause from the supervisor's
+    vocabulary and an exit code.  Torn trailing lines are tolerated (a
+    SIGKILL mid-append is this ledger's subject matter, and the readers
+    all skip them) — but only as the LAST line; torn lines mid-file mean
+    something other than a crash wrote garbage."""
+    from gansformer_tpu.supervise.events import CAUSES, KINDS
+
+    errors = []
+    with open(path) as f:
+        lines = [(i, line) for i, line in enumerate(f, 1) if line.strip()]
+    for n, (i, line) in enumerate(lines):
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            if n == len(lines) - 1:
+                continue           # torn final append: expected ending
+            errors.append(f"{path}:{i}: not JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{path}:{i}: not a JSON object "
+                          f"({type(rec).__name__})")
+            continue
+        for key, typ in SUPERVISOR_EVENT_KEYS.items():
+            if key not in rec:
+                errors.append(f"{path}:{i}: missing {key!r}")
+            elif not isinstance(rec[key], typ) or \
+                    isinstance(rec[key], bool):
+                errors.append(f"{path}:{i}: {key}={rec[key]!r} is not "
+                              f"{typ}")
+        kind = rec.get("kind")
+        if isinstance(kind, str) and kind not in KINDS:
+            errors.append(f"{path}:{i}: unknown event kind {kind!r} "
+                          f"(have {KINDS})")
+        if kind == "exit":
+            if "cause" not in rec or "exit_code" not in rec:
+                errors.append(f"{path}:{i}: exit event without "
+                              f"cause/exit_code")
+            elif rec["cause"] not in CAUSES:
+                errors.append(f"{path}:{i}: exit cause {rec['cause']!r} "
+                              f"outside the vocabulary {CAUSES}")
+    return errors
+
+
 def check_heartbeat(path: str) -> List[str]:
     errors = []
     try:
@@ -213,6 +293,17 @@ def check_run_dir(run_dir: str) -> dict:
     for path in beats:
         checked.append(os.path.basename(path))
         errors += check_heartbeat(path)
+    # Supervisor artifacts are OPTIONAL (unsupervised smoke runs don't
+    # have them) but schema-checked when present.
+    sup_prom = os.path.join(run_dir, "supervisor.prom")
+    if os.path.exists(sup_prom):
+        checked.append("supervisor.prom")
+        errors += check_prom(sup_prom)
+        errors += check_supervise_metric_families(sup_prom)
+    sup_events = os.path.join(run_dir, "supervisor_events.jsonl")
+    if os.path.exists(sup_events):
+        checked.append("supervisor_events.jsonl")
+        errors += check_supervisor_events(sup_events)
     return {"ok": not errors, "checked": checked, "errors": errors}
 
 

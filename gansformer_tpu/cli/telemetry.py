@@ -14,8 +14,11 @@ Four subcommands over the files the train loop writes
               device-time vs wall-clock MFU, wall-vs-device divergence,
               data-wait fraction, queue depths, retraces, HBM headroom,
               heartbeat staleness + per-process step skew, restart
-              count.  PASS/WARN/FAIL lines; --json for the
-              machine-readable form; exit 0 iff no FAIL.
+              count, and — when a supervisor ledger exists — the
+              availability section (ISSUE 12: exit causes, restart
+              storms, uptime ratio, give-up verdicts).  PASS/WARN/FAIL
+              lines; --json for the machine-readable form; exit 0 iff
+              no FAIL.
 
 Examples
 --------
@@ -179,7 +182,8 @@ def _fmt_bytes(n) -> str:
 def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
                expected: Optional[int] = None,
                max_step_skew: Optional[int] = None,
-               now: Optional[float] = None) -> dict:
+               now: Optional[float] = None,
+               max_restarts_per_hour: float = 6.0) -> dict:
     """The run-health report as a pure-ish dict (rendered by
     ``render_doctor``; archived verbatim by ``--json``).
 
@@ -383,9 +387,41 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
                   + ("" if max_step_skew is not None
                      else " (no --max-skew given: not judged)"))
 
-    # -- restarts (availability evidence) -----------------------------------
+    # -- restarts / availability (supervisor ledger) ------------------------
+    # supervisor_events.jsonl (supervise/events.py) supersedes the bare
+    # resumes.jsonl: exit CAUSES, downtime, and restart counts.  When the
+    # ledger exists the availability section grades it — restart storms,
+    # unclassified exits, a give-up verdict, the availability ratio;
+    # otherwise the legacy resumes.jsonl count is reported as before.
+    from gansformer_tpu.supervise import events as sup_events
     from gansformer_tpu.utils.logging import read_resume_records
 
+    sup = sup_events.read_events(run_dir)
+    if sup:
+        s = sup_events.availability(sup, now=now)
+        ratio = ("" if s["ratio"] is None
+                 else f", availability {s['ratio']:.1%} "
+                      f"(up {s['uptime_s']:.0f}s / down "
+                      f"{s['downtime_s']:.0f}s)")
+        causes = ", ".join(f"{k}x{v}" for k, v in
+                           sorted(s["causes"].items())) or "none"
+        summary = (f"{s['restarts']} restart(s), exits: {causes}{ratio}")
+        if s["gave_up"]:
+            check("availability", "FAIL",
+                  f"supervisor GAVE UP (restart budget exhausted) — "
+                  f"{summary}; the run needs a human")
+        elif s["unclassified"]:
+            check("availability", "WARN",
+                  f"unclassified exit cause(s) {s['unclassified']} in "
+                  f"the ledger — the supervisor's vocabulary rotted or "
+                  f"the file was hand-edited; {summary}")
+        elif s["restarts_last_hour"] > max_restarts_per_hour:
+            check("availability", "WARN",
+                  f"restart storm: {s['restarts_last_hour']} restart(s) "
+                  f"in the last hour (> {max_restarts_per_hour:g}) — "
+                  f"the run is thrashing, not training; {summary}")
+        else:
+            check("availability", "PASS", summary)
     resumes = read_resume_records(run_dir)
     if resumes:
         check("restarts", "PASS",
@@ -463,6 +499,10 @@ def main(argv=None) -> None:
                    help="judge inter-process step skew against this "
                         "threshold (exceeded → WARN); default: report "
                         "only")
+    d.add_argument("--max-restarts-hour", type=float, default=6.0,
+                   help="restart-storm threshold for the availability "
+                        "section (supervisor ledger restarts in the "
+                        "last hour above this → WARN)")
 
     args = p.parse_args(argv)
 
@@ -485,7 +525,8 @@ def main(argv=None) -> None:
         run_dir = resolve_run_dir(args.run_dir)
         report = run_doctor(run_dir, max_age_s=args.max_age,
                             expected=args.expected,
-                            max_step_skew=args.max_skew)
+                            max_step_skew=args.max_skew,
+                            max_restarts_per_hour=args.max_restarts_hour)
         if args.json_out:
             with open(args.json_out, "w") as f:
                 json.dump(report, f, indent=1, sort_keys=True)

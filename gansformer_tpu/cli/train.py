@@ -33,6 +33,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume from latest checkpoint in --resume-dir")
     p.add_argument("--resume-dir", default=None,
                    help="run dir to resume (default: a fresh run dir)")
+    p.add_argument("--run-dir", default=None,
+                   help="pin the run dir explicitly (no numbered-dir "
+                        "allocation) — the supervisor's contract: "
+                        "gansformer-supervise passes the same dir on "
+                        "every restart; with --resume the run continues "
+                        "if checkpoints exist, else starts fresh in "
+                        "place")
     # model overrides (reference flags: --g-arch, --components-num, ...)
     p.add_argument("--attention", choices=["none", "simplex", "duplex"])
     p.add_argument("--components", type=int, help="k latent components")
@@ -257,7 +264,17 @@ def main(argv=None) -> None:
         RunLogger, create_run_dir, list_run_dirs, next_run_id)
 
     run_dir = None
-    if args.resume:
+    if args.run_dir:
+        # Pinned run dir (the supervisor's restart contract): --resume
+        # here means "continue if there is anything to continue" — a
+        # child that crashed before its first checkpoint restarts fresh
+        # in the same dir instead of erroring.
+        run_dir = args.run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        if args.resume and not os.path.isdir(
+                os.path.join(run_dir, "checkpoints")):
+            args.resume = False
+    elif args.resume:
         run_dir = args.resume_dir or _latest_run_dir(args.results_dir)
         if run_dir is None or not os.path.isdir(
                 os.path.join(run_dir, "checkpoints")):
@@ -265,13 +282,13 @@ def main(argv=None) -> None:
                 f"--resume: no run dir with checkpoints found "
                 f"(looked in {args.resume_dir or args.results_dir}); "
                 f"pass --resume-dir explicitly")
+    if args.resume and not args.config:
         # Resume continues the RUN'S config (flags still override on top);
         # falling back to the preset would silently train a different model
         # into the old run dir.
-        if not args.config:
-            saved = os.path.join(run_dir, "config.json")
-            if os.path.exists(saved):
-                args.config = saved
+        saved = os.path.join(run_dir, "config.json")
+        if os.path.exists(saved):
+            args.config = saved
     cfg = config_from_args(args)
     init_distributed(cfg.mesh)
 
@@ -335,6 +352,24 @@ def main(argv=None) -> None:
             f.write(cfg.to_json())
     logger = RunLogger(run_dir, active=is_main)
     logger.write(f"run dir: {run_dir}")
+    if args.resume:
+        # Elastic restart (ROADMAP item 5): the devices this resume sees
+        # may not be the devices the run was checkpointed on — validate/
+        # rewrite the saved mesh config instead of crashing in make_mesh
+        # or the loop's divisibility check.  restore() returns layout-
+        # agnostic arrays and the loop re-places them through
+        # state_shardings/fsdp_spec, so the config is the only piece
+        # that needs fixing.
+        from gansformer_tpu.supervise.elastic import resolve_elastic_mesh
+
+        cfg, notes = resolve_elastic_mesh(cfg, len(jax.devices()))
+        if notes and is_main:
+            from gansformer_tpu.supervise import events
+
+            for n in notes:
+                logger.write(n)
+            events.append_event(run_dir, "elastic", notes=notes,
+                                n_devices=len(jax.devices()))
     if args.selfcheck:
         # Pre-flight: the whole analysis stack (AST rules + jaxpr trace
         # rules) in one pass, machine-readable artifact in the run dir.
@@ -370,7 +405,18 @@ def main(argv=None) -> None:
                 f"--selfcheck: {n_new} new graftlint finding(s); see "
                 f"{os.path.join(run_dir, 'graftlint.json')} — fix, "
                 f"suppress with a justification, or baseline, then rerun")
-    train(cfg, run_dir, resume=args.resume, logger=logger)
+    from gansformer_tpu.supervise.events import (
+        EXIT_PREEMPTED, PreemptionExit)
+
+    try:
+        train(cfg, run_dir, resume=args.resume, logger=logger)
+    except PreemptionExit as e:
+        # Graceful preemption (SIGTERM → final checkpoint): the DISTINCT
+        # exit code is the supervisor's classification signal — this was
+        # an orderly hand-back of the device, not a crash.
+        logger.write(f"preempted cleanly at step {e.step}; "
+                     f"exit code {EXIT_PREEMPTED}")
+        raise SystemExit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":

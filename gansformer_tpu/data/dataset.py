@@ -46,7 +46,15 @@ class Dataset:
     num_images: Optional[int] = None
 
     def batches(self, batch_size: int, seed: int = 0,
-                shard: Tuple[int, int] = (0, 1)) -> Iterator[dict]:
+                shard: Tuple[int, int] = (0, 1),
+                start_batch: int = 0) -> Iterator[dict]:
+        """Infinite batch stream.  ``start_batch`` positions the stream
+        at batch index N of the seed-determined sequence — the resume
+        contract: a run restored at iteration N consumes the same
+        batches an uninterrupted run would, so loss trajectories stay
+        tick-for-tick comparable across restarts.  Index-addressed
+        sources fast-forward by advancing the RNG stream only (no image
+        decode); sequential sources (TFRecord) document best-effort."""
         raise NotImplementedError
 
     def random_labels(self, n: int, seed: int = 0) -> Optional[np.ndarray]:
@@ -102,9 +110,11 @@ class SyntheticDataset(Dataset):
             imgs[i] = ((img * 0.5 + 0.5) * 255).astype(np.uint8)
         return imgs
 
-    def batches(self, batch_size, seed=0, shard=(0, 1)):
+    def batches(self, batch_size, seed=0, shard=(0, 1), start_batch=0):
         rs = np.random.RandomState(seed)
         shard_id, num_shards = shard
+        for _ in range(start_batch):   # advance the index stream only
+            rs.randint(0, self.num_images, size=batch_size)
         while True:
             idx = rs.randint(0, self.num_images, size=batch_size)
             idx = idx * num_shards + shard_id  # disjoint streams per host
@@ -127,10 +137,12 @@ class NpzDataset(Dataset):
         self.has_labels = self.labels is not None
         self.label_dim = 0 if self.labels is None else self.labels.shape[1]
 
-    def batches(self, batch_size, seed=0, shard=(0, 1)):
+    def batches(self, batch_size, seed=0, shard=(0, 1), start_batch=0):
         rs = np.random.RandomState(seed)
         shard_id, num_shards = shard
         local = np.arange(shard_id, self.num_images, num_shards)
+        for _ in range(start_batch):   # advance the index stream only
+            rs.randint(0, len(local), size=batch_size)
         while True:
             idx = local[rs.randint(0, len(local), size=batch_size)]
             out = {"image": self.images[idx]}
@@ -347,7 +359,13 @@ class TFRecordDataset(Dataset):
     # per host at the 4096-image default.
     SHUFFLE_BYTES_BUDGET = 512 * 1024 * 1024
 
-    def batches(self, batch_size, seed=0, shard=(0, 1)):
+    def batches(self, batch_size, seed=0, shard=(0, 1), start_batch=0):
+        # start_batch is accepted but NOT seekable here: the stream is a
+        # sequential file scan through a shuffle window, so a resumed
+        # run re-reads from the file head (best-effort resume — the
+        # strict tick-parity contract holds for index-addressed sources:
+        # synthetic/npz/folder).
+        del start_batch
         rs = np.random.RandomState(seed)
         shard_id, num_shards = shard
         # Reservoir-style shuffle window (the tf.data shuffle_buffer analog):
@@ -408,10 +426,12 @@ class ImageFolderDataset(Dataset):
         img = img.resize((self.resolution, self.resolution), Image.LANCZOS)
         return np.asarray(img, dtype=np.uint8)
 
-    def batches(self, batch_size, seed=0, shard=(0, 1)):
+    def batches(self, batch_size, seed=0, shard=(0, 1), start_batch=0):
         rs = np.random.RandomState(seed)
         shard_id, num_shards = shard
         local = np.arange(shard_id, len(self.files), num_shards)
+        for _ in range(start_batch):   # advance the index stream only
+            rs.randint(0, len(local), size=batch_size)
         while True:
             idx = local[rs.randint(0, len(local), size=batch_size)]
             yield {"image": np.stack([self._load(self.files[i]) for i in idx])}
@@ -444,8 +464,15 @@ class PrefetchIterator:
         self._h_wait_ms = telemetry.histogram("data/wait_ms")
 
         def _produce():
+            from gansformer_tpu.supervise import faults
+
             try:
-                for item in iterator:
+                for n, item in enumerate(iterator):
+                    # Fault-injection point: a 'hang' armed here models
+                    # the wedged data thread — the loop blocks in
+                    # data_wait, heartbeats go stale, and only the
+                    # supervisor's staleness probe ends the run.
+                    faults.fire("data_thread", batch=n)
                     while not self._stop.is_set():
                         try:
                             self._queue.put(item, timeout=0.1)

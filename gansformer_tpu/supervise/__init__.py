@@ -1,0 +1,24 @@
+"""Preemption-tolerant run supervision (ROADMAP item 5).
+
+Four small modules:
+
+* ``events``     — the ``supervisor_events.jsonl`` ledger + the
+                   preemption exit contract (``EXIT_PREEMPTED``,
+                   ``PreemptionExit``) + availability derivation.
+* ``faults``     — deterministic fault injection at named code points
+                   (``GANSFORMER_TPU_FAULTS``), so every recovery path
+                   is exercised by tests rather than trusted.
+* ``elastic``    — validate/rewrite a resumed run's mesh config for the
+                   devices actually visible.
+* ``supervisor`` — the child-process supervisor itself (imported on
+                   demand by ``cli/supervise.py``; NOT here, so that
+                   importing ``supervise.faults`` from hot paths stays
+                   free of subprocess machinery).
+
+Nothing in this package imports jax at module level: the supervisor
+parent must never claim the accelerator its child needs.
+"""
+
+from gansformer_tpu.supervise import events, faults  # noqa: F401
+from gansformer_tpu.supervise.events import (  # noqa: F401
+    EXIT_PREEMPTED, PreemptionExit)

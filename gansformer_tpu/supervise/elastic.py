@@ -1,0 +1,118 @@
+"""Elastic restart rules — re-mesh a resumed run onto the devices it has.
+
+Preemptible capacity does not come back the same size: a run
+checkpointed on a 2-chip claim may resume on 1 chip (or 4).  Everything
+below the config already tolerates that — ``restore()`` returns
+layout-agnostic default-device arrays, the loop re-places them through
+``state_shardings`` (FSDP leaves re-shard via the per-leaf ``fsdp_spec``
+rule on the NEW mesh), and batches re-shard per ``MeshEnv.batch()``.
+The one thing that crashed was the *saved mesh config*: a pinned
+``mesh.data`` that no longer fits raises in ``make_mesh``, and a
+derived data axis that stops dividing the batch raises in the loop.
+
+``resolve_elastic_mesh`` is the missing validation/rewrite step the
+train CLI runs on every ``--resume``:
+
+* a pinned ``data`` axis that fits and divides the batch is respected;
+* a pinned axis that no longer fits is rewritten to ``-1`` (use all
+  devices) so a later restart on MORE devices grows back automatically;
+* a derived axis that does not divide the global batch is pinned to
+  the largest divisor that fits (batch size is part of the training
+  run's identity; the mesh bends, the batch does not);
+* FSDP is kept where expressible — on a derived data=1 mesh the
+  per-leaf rule degrades to replicated placement by construction; a
+  rewrite that must PIN data=1 disables it (with a note) until a wider
+  claim returns;
+* combos the sharding contracts cannot express are REFUSED with words:
+  the model axis (sequence-parallel activation sharding) never
+  re-sizes, and multi-host process groups are out of elastic scope.
+
+Every rewrite is reported as a note (logged + appended to the
+supervisor ledger as an ``elastic`` event by the caller).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+from gansformer_tpu.core.config import ExperimentConfig
+
+
+class ElasticMeshError(ValueError):
+    """The visible devices cannot express the run's sharding contract."""
+
+
+def largest_dividing(batch: int, cap: int) -> int:
+    """Largest d in [1, cap] with batch % d == 0 (d=1 always works)."""
+    for d in range(max(1, cap), 0, -1):
+        if batch % d == 0:
+            return d
+    return 1
+
+
+def resolve_elastic_mesh(cfg: ExperimentConfig, n_devices: int
+                         ) -> Tuple[ExperimentConfig, List[str]]:
+    """Validate/rewrite ``cfg.mesh`` for ``n_devices`` visible devices.
+    Returns ``(cfg', notes)`` — notes empty when nothing changed; raises
+    ``ElasticMeshError`` for combos restarting cannot fix."""
+    mesh, batch = cfg.mesh, cfg.train.batch_size
+    notes: List[str] = []
+    if mesh.coordinator_address is not None or (mesh.num_processes or 1) > 1:
+        # Multi-host elasticity needs a process-group re-form, not a
+        # config rewrite; validate-only so a fitting pod still resumes.
+        if mesh.data > 0 and mesh.data * mesh.model > n_devices:
+            raise ElasticMeshError(
+                f"resume: multi-host mesh {mesh.data}x{mesh.model} needs "
+                f"{mesh.data * mesh.model} devices, {n_devices} visible — "
+                f"elastic re-mesh is single-host only; re-launch with a "
+                f"matching process set")
+        return cfg, notes
+    if mesh.model > n_devices:
+        raise ElasticMeshError(
+            f"resume: mesh.model={mesh.model} (sequence-parallel "
+            f"activation sharding) cannot shrink onto {n_devices} visible "
+            f"device(s) — the model axis is part of the compiled programs' "
+            f"contract; restore this run on ≥{mesh.model} devices or "
+            f"retrain with a smaller model axis")
+    avail_rows = max(1, n_devices // mesh.model)
+    data = mesh.data
+    if data > 0 and data <= avail_rows and batch % data == 0:
+        return cfg, notes          # pinned and still expressible: respect it
+    if data > 0:
+        # Pinned but no longer expressible: -1 ("all devices") both fits
+        # now and grows back when the bigger claim returns.
+        if batch % avail_rows == 0:
+            notes.append(
+                f"elastic: mesh.data={data} does not fit {n_devices} "
+                f"visible device(s); re-meshed to data=-1 "
+                f"({avail_rows} row(s) now)")
+            data = -1
+        else:
+            d = largest_dividing(batch, avail_rows)
+            notes.append(
+                f"elastic: mesh.data={data} does not fit {n_devices} "
+                f"visible device(s) and batch {batch} is not divisible "
+                f"by {avail_rows}; re-meshed to data={d}")
+            data = d
+    else:  # data == -1: derived axis — only the divisibility can break
+        if batch % avail_rows != 0:
+            d = largest_dividing(batch, avail_rows)
+            notes.append(
+                f"elastic: derived data axis {avail_rows} does not divide "
+                f"batch {batch}; pinned data={d}")
+            data = d
+    if not notes:
+        return cfg, notes
+    fsdp = mesh.fsdp
+    if fsdp and data == 1:
+        # validate() refuses a literal data=1 with fsdp (nothing to shard
+        # over); a data=-1 that *derives* to 1 is fine — the per-leaf
+        # rule degrades to replicated placement.
+        notes.append("elastic: fsdp disabled — the re-meshed data axis "
+                     "is 1, so optimizer state is replicated until a "
+                     "wider claim returns")
+        fsdp = False
+    cfg = dataclasses.replace(
+        cfg, mesh=dataclasses.replace(mesh, data=data, fsdp=fsdp))
+    return cfg.validate(), notes

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -54,12 +55,20 @@ import numpy as np
 from gansformer_tpu.core.config import ExperimentConfig
 from gansformer_tpu.obs import registry as telemetry
 from gansformer_tpu.obs.spans import span
+from gansformer_tpu.supervise import faults
 from gansformer_tpu.train.state import TrainState
 from gansformer_tpu.utils.background import SingleSlotWriter
 
 STATE_FILE = "state.npz"
 
 _WRITERS: Dict[str, SingleSlotWriter] = {}
+
+# Serializes the final-directory swap (rename-aside + replace + trash
+# cleanup) across threads: the preemption path sync-saves the SAME step
+# a timed-out async writer may still be finishing, and two unserialized
+# os.replace calls onto one final dir race into ENOTEMPTY.  Only the
+# cheap swap serializes — npz serialization stays parallel.
+_SWAP_LOCK = threading.Lock()
 
 # Test seam (tests/test_checkpoint_async.py): called with the step number
 # after the temp file is fully written, BEFORE the atomic rename — a hook
@@ -97,8 +106,15 @@ def _write_state_dir(ckpt_dir: str, step: int, host_leaves: List[np.ndarray],
     """Serialize → temp dir → fsync → atomic rename.  Any failure cleans
     the temp dir and re-raises; the previous checkpoint is never touched."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    tmp = os.path.join(ckpt_dir, f".tmp-{step}-{os.getpid()}")
+    # Thread id in the tmp name: the preemption path writes a SYNC save
+    # of the current step from the loop thread while a timed-out async
+    # writer may still be writing the SAME step from its thread — a
+    # pid-only name would interleave two np.savez streams into one file.
+    tmp = os.path.join(
+        ckpt_dir,
+        f".tmp-{step}-{os.getpid()}-{threading.get_ident()}")
     final = os.path.join(ckpt_dir, str(step))
+    trash = None
     try:
         os.makedirs(tmp, exist_ok=True)
         path = os.path.join(tmp, STATE_FILE)
@@ -109,18 +125,43 @@ def _write_state_dir(ckpt_dir: str, step: int, host_leaves: List[np.ndarray],
             os.fsync(f.fileno())
         if _WRITE_HOOK is not None:
             _WRITE_HOOK(step)
-        if os.path.isdir(final):       # re-save of the same step
-            shutil.rmtree(final)
-        os.replace(tmp, final)
-        # fsync the parent so the rename itself survives a power cut
-        dfd = os.open(ckpt_dir, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        # Fault-injection point (supervise/faults.py): SIGKILL here
+        # models the classic preemption-mid-checkpoint crash the atomic
+        # rename exists for.
+        faults.fire("ckpt_mid_write", step=step)
+        with _SWAP_LOCK:
+            if os.path.isdir(final):
+                # Re-save of the same step: move the old dir ASIDE and
+                # delete it only after the new one is in place — a
+                # writer killed between a plain rmtree and the replace
+                # (e.g. an abandoned async thread dying at interpreter
+                # exit while the preemption path re-saved the step)
+                # must never leave the step missing entirely.
+                trash = tmp + ".old"
+                os.rename(final, trash)
+            os.replace(tmp, final)
+            # fsync the parent so the rename itself survives a power cut
+            dfd = os.open(ckpt_dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        if trash is not None:
+            shutil.rmtree(trash, ignore_errors=True)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
+        if trash is not None and not os.path.isdir(final):
+            # the replace never landed: put the old step back
+            try:
+                os.rename(trash, final)
+            except OSError:
+                pass
         raise
+    # Fault-injection point: the 'torn' action truncates the just-landed
+    # npz, modeling a filesystem that lied about durability — the next
+    # restore must walk back to the previous step.
+    faults.fire("ckpt_after_write", step=step,
+                path=os.path.join(final, STATE_FILE))
     _apply_retention(ckpt_dir, keep=max_to_keep)
 
 
@@ -210,14 +251,19 @@ def check_error(ckpt_dir: str) -> None:
         _WRITERS[key].poll()
 
 
-def wait(ckpt_dir: str, reraise: bool = True) -> None:
+def wait(ckpt_dir: str, reraise: bool = True,
+         timeout: Optional[float] = None) -> bool:
     """Join any in-flight async save for this directory.  ``reraise=False``
     is for ``finally`` blocks (a writer failure must not mask the
     exception already unwinding — it resurfaces via ``check_error`` /
-    the next ``wait``)."""
+    the next ``wait``).  ``timeout`` bounds the join (the preemption
+    grace window: a wedged writer thread must not eat the final
+    checkpoint's budget); returns False when the writer is still busy
+    after it."""
     key = os.path.abspath(ckpt_dir)
     if key in _WRITERS:
-        _WRITERS[key].wait(reraise=reraise)
+        return _WRITERS[key].wait(reraise=reraise, timeout=timeout)
+    return True
 
 
 def _all_steps(ckpt_dir: str) -> List[int]:
@@ -318,19 +364,81 @@ def _restore_orbax(ckpt_dir: str, step: int,
     return mgr.restore(step, args=ocp.args.StandardRestore(template))
 
 
+def _quarantine(ckpt_dir: str, step: int) -> str:
+    """Rename a step dir that failed to decode to ``<step>.corrupt`` so
+    ``latest_step``/retention stop seeing it but a human still can (the
+    bytes may be forensically interesting; they are NOT re-deleted by
+    retention).  Returns the new path."""
+    src = os.path.join(ckpt_dir, str(step))
+    dst = os.path.join(ckpt_dir, f"{step}.corrupt")
+    i = 0
+    while os.path.exists(dst):           # repeated corruption of a re-save
+        i += 1
+        dst = os.path.join(ckpt_dir, f"{step}.corrupt{i}")
+    os.replace(src, dst)
+    return dst
+
+
 def restore(ckpt_dir: str, template: TrainState,
             step: Optional[int] = None) -> TrainState:
     """Restore into the structure of ``template`` (shapes/dtypes come from
     the template; leaves come back as default-device jax arrays — callers
-    ``device_put`` onto their mesh, which works under any layout)."""
-    step = step if step is not None else latest_step(ckpt_dir)
-    if step is None:
+    ``device_put`` onto their mesh, which works under any layout).
+
+    Latest-step restores (``step=None``) are RESILIENT: a torn or
+    template-mismatched ``state.npz`` — the normal aftermath of a
+    SIGKILL that beat the atomic rename's durability, or a filesystem
+    that lied about it — walks back to the newest step that decodes
+    cleanly.  The bad step dir is quarantined (renamed to
+    ``<step>.corrupt``) so the next ``latest_step`` probe and retention
+    skip it, and ``ckpt/restore_fallback_total`` counts the event.
+    An EXPLICIT ``step`` keeps the old hard-fail contract — the caller
+    asked for that step, substituting another would be a silent lie.
+    Legacy Orbax step dirs (no npz) never quarantine: their errors are
+    environmental (package missing), not evidence of corruption."""
+    explicit = step is not None
+    candidates = [step] if explicit else list(reversed(_all_steps(ckpt_dir)))
+    if not candidates:
         raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
-    npz = os.path.join(ckpt_dir, str(step), STATE_FILE)
+    last_err: Optional[Exception] = None
     with span("ckpt/restore") as sp:
-        if os.path.exists(npz):
-            out = _restore_npz(npz, template)
+        for s in candidates:
+            step_dir = os.path.join(ckpt_dir, str(s))
+            npz = os.path.join(step_dir, STATE_FILE)
+            if not os.path.exists(npz):
+                if not explicit and not os.path.isdir(step_dir):
+                    # a peer process quarantined this step between our
+                    # directory listing and here (shared run dir,
+                    # multi-host resume) — walk on
+                    continue
+                out = _restore_orbax(ckpt_dir, s, template)
+                break
+            try:
+                out = _restore_npz(npz, template)
+                break
+            except Exception as e:
+                if explicit:
+                    raise
+                try:
+                    quarantined = _quarantine(ckpt_dir, s)
+                except (FileNotFoundError, OSError):
+                    # a peer's quarantine rename won the race — same
+                    # verdict, no need to move anything ourselves
+                    quarantined = f"{s}.corrupt (by a peer process)"
+                telemetry.counter("ckpt/restore_fallback_total").inc()
+                print(f"[ckpt] step {s} failed to decode "
+                      f"({type(e).__name__}: {str(e)[:200]}); quarantined "
+                      f"to {quarantined}, walking back", flush=True)
+                last_err = e
         else:
-            out = _restore_orbax(ckpt_dir, step, template)
+            err = ValueError(
+                f"no checkpoint under {ckpt_dir} decodes cleanly"
+                + (f"; last error: {type(last_err).__name__}: {last_err}"
+                   if last_err is not None else
+                   " (every candidate vanished mid-walk — quarantined "
+                   "or pruned by a peer process?)"))
+            if last_err is not None:
+                raise err from last_err
+            raise err
     telemetry.gauge("ckpt/restore_ms").set(sp.duration_s * 1000.0)
     return out

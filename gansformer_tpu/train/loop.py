@@ -16,6 +16,8 @@ immediately continues.
 from __future__ import annotations
 
 import os
+import signal
+import threading
 import time
 from typing import Optional
 
@@ -23,6 +25,8 @@ import jax
 import numpy as np
 
 from gansformer_tpu import obs
+from gansformer_tpu.supervise import faults
+from gansformer_tpu.supervise.events import PreemptionExit
 from gansformer_tpu.core.config import ExperimentConfig
 from gansformer_tpu.data.dataset import PrefetchIterator, make_dataset
 from gansformer_tpu.data.device_prefetch import DevicePrefetcher
@@ -108,6 +112,61 @@ def resolve_conditional(cfg: ExperimentConfig, dataset) -> ExperimentConfig:
     return cfg
 
 
+class _PreemptNotice:
+    """SIGTERM → graceful-checkpoint request (ROADMAP item 5).
+
+    The handler only flips a flag; the loop polls it at dispatch
+    boundaries (signal-handler-safe by construction — no locks, no I/O).
+    ``shutdown_timeout_s`` is set once preemption shutdown begins so the
+    ``finally`` path bounds its writer joins to the remaining grace
+    window instead of blocking on a possibly-wedged thread."""
+
+    def __init__(self):
+        self.requested = False
+        self.shutdown_timeout_s: Optional[float] = None
+
+    def _handler(self, signum, frame):
+        self.requested = True
+
+    def install(self):
+        """Install on SIGTERM when possible (main thread only — tests
+        and library callers off the main thread just never see the
+        graceful path).  Returns a restore callable."""
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+        try:
+            prev = signal.signal(signal.SIGTERM, self._handler)
+        except (ValueError, OSError):
+            return lambda: None
+        return lambda: signal.signal(signal.SIGTERM, prev)
+
+
+def preempt_grace_s() -> float:
+    """The SIGTERM→exit budget (seconds).  The supervisor exports it to
+    the child's env; standalone runs get a conservative default."""
+    try:
+        return float(os.environ.get("GANSFORMER_TPU_PREEMPT_GRACE_S", "30"))
+    except ValueError:
+        return 30.0
+
+
+def _preemption_checkpoint(state, ckpt_dir: str, cfg: ExperimentConfig,
+                           grace: float) -> int:
+    """The graceful-preemption endgame (runs ONCE, not per iteration —
+    deliberately outside the hot loop and its sync discipline): settle
+    the in-flight step, bound the async-writer join to the grace window
+    (a wedged daemon writer must not eat it), and write one final
+    synchronous checkpoint unless the current step is already on disk.
+    Returns the step the run exits at."""
+    jax.block_until_ready(state.step)
+    ckpt.wait(ckpt_dir, reraise=False, timeout=max(1.0, grace / 2))
+    step_now = int(jax.device_get(state.step))
+    if ckpt.latest_step(ckpt_dir) != step_now:
+        with span("checkpoint"):
+            ckpt.save(ckpt_dir, state, cfg, block=True)
+    return step_now
+
+
 def train(cfg: ExperimentConfig, run_dir: str,
           env: Optional[MeshEnv] = None,
           resume: bool = False,
@@ -119,16 +178,27 @@ def train(cfg: ExperimentConfig, run_dir: str,
     # (ModelConfig.sequence_parallel) resolve bare PartitionSpecs against it.
     # RunLogger as context manager: stats.jsonl/log.txt/TensorBoard files
     # close (and the last write is flushed) even when training raises.
-    with env.activate():
-        with (logger or RunLogger(run_dir)) as log:
-            return _train(cfg, run_dir, env, resume, total_kimg, log)
+    # SIGTERM = preemption notice: installed for the whole run (compiles
+    # included) so a notice during setup still resolves at the first
+    # loop-boundary poll instead of killing the process mid-compile.
+    preempt = _PreemptNotice()
+    restore_handler = preempt.install()
+    try:
+        with env.activate():
+            with (logger or RunLogger(run_dir)) as log:
+                return _train(cfg, run_dir, env, resume, total_kimg, log,
+                              preempt)
+    finally:
+        restore_handler()
 
 
 def _train(cfg: ExperimentConfig, run_dir: str,
            env: MeshEnv,
            resume: bool,
            total_kimg: Optional[int],
-           log: RunLogger) -> TrainState:
+           log: RunLogger,
+           preempt: Optional[_PreemptNotice] = None) -> TrainState:
+    preempt = preempt or _PreemptNotice()
     t = cfg.train
     total_kimg = total_kimg if total_kimg is not None else t.total_kimg
 
@@ -205,17 +275,28 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     # undelivered async-writer error on this directory — it was THAT
     # run's diagnostics, not this one's.
     ckpt.reset_errors(ckpt_dir)
-    if resume:
-        last = ckpt.latest_step(ckpt_dir)
-        if last is not None:
-            state = ckpt.restore(ckpt_dir, state)
-            log.write(f"resumed from step {last} ({last / 1000:.1f} kimg)")
-            if jax.process_index() == 0:
-                # One line per restart (resumes.jsonl): the run doctor's
-                # restart-count / availability evidence (ROADMAP item 5).
-                from gansformer_tpu.utils.logging import append_resume_record
+    resumed = False
+    if resume and ckpt.latest_step(ckpt_dir) is not None:
+        # restore() walks back past torn/corrupt latest steps
+        # (quarantining them), so the step actually restored is read
+        # from the state, not from the directory listing.
+        state = ckpt.restore(ckpt_dir, state)
+        resumed = True
+    # ONE step fetch feeds the resume log, the data-stream alignment
+    # (start_batch), and the loop's starting counters below — deriving
+    # them separately invites a silent divergence that would break the
+    # tick-for-tick resume-parity contract.
+    start_step = int(jax.device_get(state.step))
+    if resumed:
+        log.write(f"resumed from step {start_step} "
+                  f"({start_step / 1000:.1f} kimg)")
+        if jax.process_index() == 0:
+            # One line per restart (resumes.jsonl + the supervisor
+            # ledger): the run doctor's restart-count / availability
+            # evidence (ROADMAP item 5).
+            from gansformer_tpu.utils.logging import append_resume_record
 
-                append_resume_record(run_dir, step=last)
+            append_resume_record(run_dir, step=start_step)
 
     # State placement: params/EMA/stats replicated across the mesh;
     # under --fsdp the optimizer moments shard per-leaf over the data
@@ -246,7 +327,13 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     # SURVEY.md §7.3 item 6).
     multihost = jax.process_count() > 1
     local_bs = local_batch_size(t.batch_size, env) if multihost else t.batch_size
-    batch_iter = dataset.batches(local_bs, seed=t.seed + 1, shard=shard)
+    # start_batch aligns the data stream to the restored step: a resumed
+    # run re-consumes the SAME batch sequence an uninterrupted run would
+    # see at that iteration, which is what makes kill→resume loss
+    # trajectories tick-for-tick identical (tests/test_supervise.py).
+    start_it = start_step // t.batch_size
+    batch_iter = dataset.batches(local_bs, seed=t.seed + 1, shard=shard,
+                                 start_batch=start_it)
     batch_sharding = env.batch()
 
     def put_batch(host_arr: np.ndarray) -> jax.Array:
@@ -375,9 +462,14 @@ def _train(cfg: ExperimentConfig, run_dir: str,
         return group.run(sample_fn, dataset, pair_fn=pair_fn)
 
     # --- loop ----------------------------------------------------------------
-    cur_nimg = int(jax.device_get(state.step))
-    heartbeat.beat(step=cur_nimg, kimg=cur_nimg / 1000)
-    it = cur_nimg // t.batch_size
+    cur_nimg = start_step
+    # phase="setup": this beat precedes the first-dispatch compiles, so
+    # a supervisor must keep judging liveness against its STARTUP grace
+    # (not the steady-state heartbeat budget) until a tick beat lands —
+    # supervise/supervisor.probe_hang reads the phase for exactly that.
+    heartbeat.beat(step=cur_nimg, kimg=cur_nimg / 1000,
+                   extra={"phase": "setup"})
+    it = start_it
     tick = 0
     tick_start_nimg = cur_nimg
     # Setup spans (ckpt/restore on resume) ran outside any tick window:
@@ -442,6 +534,20 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     base_rng = jax.random.PRNGKey(t.seed + 4)
     try:
         while cur_nimg < total_kimg * 1000:
+            if preempt.requested:
+                # Graceful preemption (SIGTERM): ONE final synchronous
+                # checkpoint + flush inside the grace window, then a
+                # distinct exit the supervisor classifies as preemption,
+                # not crash.
+                grace = preempt_grace_s()
+                preempt.shutdown_timeout_s = max(1.0, grace / 4)
+                log.write(f"preemption notice (SIGTERM): final "
+                          f"checkpoint within {grace:.0f}s grace")
+                step_now = _preemption_checkpoint(state, ckpt_dir, cfg,
+                                                  grace)
+                log.write(f"preemption checkpoint @ step {step_now}; "
+                          f"exiting for resume")
+                raise PreemptionExit(step_now)
             # Phase spans (obs/spans.py): data_wait is the time the loop
             # BLOCKS on the prefetch queue — previously folded silently
             # into step time; h2d is host→device transfer/assembly; step
@@ -618,6 +724,10 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                 ckpt.check_error(ckpt_dir)
                 if snap_writer is not None:
                     snap_writer.poll()
+                # Fault-injection point (supervise/faults.py): the tick
+                # boundary is where a scripted SIGTERM "preemption
+                # notice" or SIGKILL lands deterministically.
+                faults.fire("tick", tick=tick, step=cur_nimg)
                 tick += 1
                 tick_start_nimg = cur_nimg
                 tick_start_time = time.time()
@@ -690,15 +800,24 @@ def _train(cfg: ExperimentConfig, run_dir: str,
         # Join in-flight background writes WITHOUT re-raising: on the
         # exceptional path a writer failure must not mask the training
         # exception already unwinding (it resurfaces via wait() below on
-        # the clean path).
+        # the clean path).  Under preemption shutdown the joins are
+        # bounded — a wedged (daemon) writer thread must not block the
+        # exit past the grace window.
         if snap_writer is not None:
-            snap_writer.wait(reraise=False)
-        ckpt.wait(ckpt_dir, reraise=False)
+            snap_writer.wait(reraise=False,
+                             timeout=preempt.shutdown_timeout_s)
+        ckpt.wait(ckpt_dir, reraise=False,
+                  timeout=preempt.shutdown_timeout_s)
         # final telemetry: whatever accumulated since the last tick still
         # reaches events.jsonl / telemetry.prom / the heartbeat, and the
         # heartbeat records the last step an aborted run reached.
+        # phase="finalize": the post-loop final snapshot + synchronous
+        # checkpoint follow with no tick beats — a supervisor must judge
+        # that window against its startup grace (probe_hang), or a slow
+        # final save would be killed as a hang seconds from completion.
         tracer.flush()
-        heartbeat.beat(step=cur_nimg, kimg=cur_nimg / 1000)
+        heartbeat.beat(step=cur_nimg, kimg=cur_nimg / 1000,
+                       extra={"phase": "finalize"})
         if jax.process_index() == 0:
             obs.get_registry().write_prom(prom_path)
 

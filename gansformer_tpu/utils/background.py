@@ -90,6 +90,22 @@ class LoopWorker:
     def join(self, timeout: Optional[float] = None) -> None:
         self._thread.join(timeout)
 
+    def wait(self, reraise: bool = True,
+             timeout: Optional[float] = None) -> bool:
+        """Bounded join + optional error delivery.  Returns False when
+        the loop thread is still running after ``timeout`` — a wedged
+        dispatch must not block a preemption shutdown past its grace
+        window (the thread is a daemon; abandoning it is safe)."""
+        self._thread.join(timeout)
+        if reraise:
+            self.poll()
+        return not self._thread.is_alive()
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Shutdown-path join: never raises (the sticky error stays for
+        ``poll``), just reports whether the thread ended in time."""
+        return self.wait(reraise=False, timeout=timeout)
+
     @property
     def alive(self) -> bool:
         return self._thread.is_alive()
@@ -154,15 +170,29 @@ class SingleSlotWriter:
                 f"{f' ({job})' if job else ''} failed: "
                 f"{type(err).__name__}: {err}") from err
 
-    def wait(self, reraise: bool = True) -> None:
+    def wait(self, reraise: bool = True,
+             timeout: Optional[float] = None) -> bool:
         """Join the in-flight job (if any); optionally re-raise failures.
         ``reraise=False`` is for ``finally`` blocks where a writer error
-        must not mask the exception already unwinding."""
+        must not mask the exception already unwinding.  ``timeout``
+        bounds the join (preemption shutdown: a wedged writer must not
+        eat the grace window); returns False when the job is still
+        running after it — the sticky-error contract is untouched (an
+        already-stored failure is still delivered when ``reraise``, and
+        a failure that lands later surfaces at the next poll/wait)."""
         t = self._thread
+        joined = True
         if t is not None:
-            t.join()
+            t.join(timeout)
+            joined = not t.is_alive()
         if reraise:
             self.poll()
+        return joined
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Shutdown-path join: never raises; reports whether the writer
+        drained in time (daemon thread — abandoning it is safe)."""
+        return self.wait(reraise=False, timeout=timeout)
 
     def clear_error(self) -> None:
         """Drop an undelivered sticky error WITHOUT raising it.  For run

@@ -42,10 +42,23 @@ def append_resume_record(run_dir: str, step: int) -> None:
     """One JSON line per ``--resume`` restart → ``resumes.jsonl``.  The
     run doctor counts these as the restart/availability evidence (ISSUE
     8 / ROADMAP item 5): a run dir with N lines survived N preemptions
-    or crashes, and the last line says where it picked back up."""
+    or crashes, and the last line says where it picked back up.
+
+    The richer ``supervisor_events.jsonl`` schema (supervise/events.py)
+    supersedes this file; it is kept for back-compat readers.  An
+    UNSUPERVISED ``--resume`` also mirrors its record into the
+    supervisor ledger (kind ``resume``) so the doctor's availability
+    section sees manual re-arms too; under ``gansformer-supervise`` the
+    supervisor owns the ledger and the mirror is skipped (it would
+    double-count the restart the supervisor already logged)."""
     rec = {"time": time.time(), "step": int(step), "pid": os.getpid()}
     with open(os.path.join(run_dir, "resumes.jsonl"), "a") as f:
         f.write(json.dumps(rec) + "\n")
+    if not os.environ.get("GANSFORMER_TPU_SUPERVISED"):
+        from gansformer_tpu.supervise import events
+
+        events.append_event(run_dir, "resume", step=int(step),
+                            source="train")
 
 
 def read_resume_records(run_dir: str):
@@ -123,6 +136,12 @@ class RunLogger:
             return
         print(msg)
         sys.stdout.flush()
+        if self._closed:
+            # post-close writes (the CLI's preemption farewell runs after
+            # train()'s context manager released the files) still reach
+            # the console; writing to the closed file would raise and
+            # turn a clean preemption exit into a crash code.
+            return
         self.log_file.write(msg + "\n")
         self.log_file.flush()
 

@@ -158,7 +158,14 @@ def default_stages():
         stage("pallas_train_ab", 1500, "pallas_train_ab_tpu.jsonl",
               [py, "scripts/bench_pallas_attention.py", "--train-ab",
                "--batch", "8"]),
-        # 9. Real loop on the chip; stats.jsonl carries timing/mfu.
+        # 9. Real loop on the chip — now run UNDER the supervisor with
+        #    one injected SIGKILL mid-checkpoint (ISSUE 12), so every
+        #    tunnel window that trains also PROVES crash→resume recovery
+        #    on real hardware: the kill fires once (fault ledger), the
+        #    supervisor classifies it and re-arms, and the run completes
+        #    to 8 kimg with a supervisor_events.jsonl the doctor's
+        #    availability section grades.  stats.jsonl carries
+        #    timing/mfu as before.
         #    --device-time-ticks 0: the periodic device-truth sampler is
         #    OFF for this unattended stage — a client killed mid-trace
         #    was observed (r4) to wedge the tunnel's backend claim for
@@ -167,16 +174,20 @@ def default_stages():
         #    battery comes from the witness/doctor instead.  After the
         #    run, the doctor's JSON report (ISSUE 8) is archived into
         #    the window ledger; capture beats verdict (same rationale as
-        #    graftcomms) — the stage completes on the TRAIN exit code.
+        #    graftcomms) — the stage completes on the SUPERVISE exit
+        #    code (0 = trained through the injected crash).
         stage("train_ticks", 1200, None,
               ["sh", "-c",
-               f"{py} -m gansformer_tpu.cli.train"
+               f"{py} -m gansformer_tpu.cli.supervise"
+               f" --run-dir {{win}}/train_tpu/run"
+               f" --max-restarts 4 --poll-interval 5"
+               f" --heartbeat-max-age 300 --startup-grace 600"
+               f" --fault sigkill@ckpt_mid_write:step=4000 --"
                f" --preset ffhq256-duplex --data-source synthetic"
                f" --batch-size 8 --total-kimg 8 --fused-cycle"
-               f" --device-time-ticks 0"
-               f" --results-dir {{win}}/train_tpu; rc=$?;"
+               f" --device-time-ticks 0; rc=$?;"
                f" {py} -m gansformer_tpu.cli.telemetry doctor"
-               f" {{win}}/train_tpu --json-out {{win}}/doctor.json;"
+               f" {{win}}/train_tpu/run --json-out {{win}}/doctor.json;"
                f" exit $rc"]),
     ]
 

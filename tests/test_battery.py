@@ -134,6 +134,26 @@ def test_scaling_stage_runs_bench_scaling():
         st["budget_s"] - 150
 
 
+def test_train_ticks_stage_runs_under_supervisor():
+    """ISSUE 12 satellite: every tunnel window that trains also PROVES
+    recovery — the train stage runs under gansformer-supervise with one
+    injected SIGKILL mid-checkpoint (one-shot via the fault ledger) and
+    the doctor's JSON (availability section included) is archived into
+    the window."""
+    stages = {s["name"]: s for s in battery.default_stages()}
+    st = stages["train_ticks"]
+    argv = " ".join(st["argv"])
+    assert "gansformer_tpu.cli.supervise" in argv
+    assert "--run-dir {win}/train_tpu/run" in argv
+    assert "--fault sigkill@ckpt_mid_write:step=4000" in argv
+    assert "--max-restarts" in argv
+    assert "gansformer_tpu.cli.telemetry doctor" in argv
+    assert "--json-out {win}/doctor.json" in argv
+    # the unattended-stage discipline survives the rewrite: device-time
+    # sampler off (a killed trace can wedge the tunnel's claim)
+    assert "--device-time-ticks 0" in argv
+
+
 def test_default_probe_cmd_env_override(monkeypatch):
     monkeypatch.setenv("GRAFT_PROBE_CMD", "true")
     assert battery.default_probe_argv() == ["sh", "-c", "true"]

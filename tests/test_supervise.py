@@ -1,0 +1,799 @@
+"""Preemption-tolerant supervision (ISSUE 12): exit classification,
+fault injection, torn-checkpoint walk-back, bounded shutdown, elastic
+re-mesh, the availability ledger + doctor section — and (slow) the
+scripted fault plan: kill -9 mid-checkpoint → resume → SIGTERM → resume,
+asserting per-tick loss parity against an uninterrupted run, plus an
+elastic 1↔2 virtual-CPU-device restart."""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from gansformer_tpu.supervise import events, faults
+from gansformer_tpu.supervise.elastic import (
+    ElasticMeshError, resolve_elastic_mesh)
+from gansformer_tpu.supervise.supervisor import (
+    SupervisorConfig, classify_exit, probe_hang, supervise)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every test starts and ends with no armed faults (the module state
+    is process-global and lazily env-initialized)."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# --- exit classification -----------------------------------------------------
+
+def test_classify_exit():
+    assert classify_exit(0) == "clean"
+    assert classify_exit(events.EXIT_PREEMPTED) == "preemption"
+    assert classify_exit(-signal.SIGTERM) == "preemption"
+    assert classify_exit(-signal.SIGKILL) == "crash"
+    assert classify_exit(1) == "crash"
+    assert classify_exit(139) == "crash"
+    # the supervisor's own kill verdict outranks whatever code resulted
+    assert classify_exit(0, killed_for_hang=True) == "hang"
+    assert classify_exit(-signal.SIGKILL, killed_for_hang=True) == "hang"
+
+
+# --- fault specs -------------------------------------------------------------
+
+def test_fault_spec_parsing():
+    specs = faults.parse_specs(
+        "sigkill@ckpt_mid_write:step=2000,sigterm@tick:tick=1,step=3")
+    assert len(specs) == 2
+    assert specs[0].action == "sigkill" and \
+        specs[0].point == "ckpt_mid_write"
+    assert specs[0].cond == (("step", 2000.0),)
+    # conditions may themselves be comma-separated inside one spec
+    assert specs[1].cond == (("tick", 1.0), ("step", 3.0))
+    assert faults.parse_spec("hang@data_thread").cond == ()
+    with pytest.raises(ValueError, match="expected"):
+        faults.parse_spec("nonsense")
+    with pytest.raises(ValueError, match="unknown action"):
+        faults.parse_spec("explode@tick")
+
+
+def test_fault_fires_once_and_ledger_survives_rearm(tmp_path):
+    led = str(tmp_path / "led.jsonl")
+    faults.arm(faults.parse_specs("raise@tick:step=10"), led)
+    faults.fire("tick", step=5)                     # below threshold
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("tick", step=10)
+    faults.fire("tick", step=11)                    # one-shot: no re-fire
+    # a restarted process (same env) re-arms and reads the ledger
+    faults.arm(faults.parse_specs("raise@tick:step=10"), led)
+    faults.fire("tick", step=12)
+    recs = [json.loads(l) for l in open(led)]
+    assert len(recs) == 1 and recs[0]["point"] == "tick"
+
+
+def test_fault_torn_action_truncates(tmp_path):
+    p = tmp_path / "state.npz"
+    p.write_bytes(b"x" * 1000)
+    faults.arm(faults.parse_specs("torn@ckpt_after_write:step=1"), None)
+    faults.fire("ckpt_after_write", step=1, path=str(p))
+    assert 0 < p.stat().st_size < 1000
+
+
+# --- torn-latest checkpoint walk-back ---------------------------------------
+
+def test_restore_walks_back_and_quarantines(tmp_path):
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.train import checkpoint as ckpt
+    from tests.test_checkpoint_async import (
+        assert_trees_equal, tiny_state)
+
+    d = str(tmp_path / "ck")
+    good = tiny_state(step=100, scale=3.0)
+    ckpt.save(d, good, block=True)
+    ckpt.save(d, tiny_state(step=200, scale=5.0), block=True)
+    p = os.path.join(d, "200", "state.npz")
+    with open(p, "r+b") as f:                 # tear the latest
+        f.truncate(os.path.getsize(p) // 2)
+    before = telemetry.counter("ckpt/restore_fallback_total").value
+    restored = ckpt.restore(d, tiny_state())
+    assert_trees_equal(good, restored)
+    assert os.path.isdir(os.path.join(d, "200.corrupt"))
+    assert ckpt.latest_step(d) == 100          # quarantine hid the bad dir
+    assert telemetry.counter(
+        "ckpt/restore_fallback_total").value == before + 1
+
+
+def test_restore_explicit_step_still_hard_fails(tmp_path):
+    from gansformer_tpu.train import checkpoint as ckpt
+    from tests.test_checkpoint_async import tiny_state
+
+    d = str(tmp_path / "ck")
+    ckpt.save(d, tiny_state(step=300), block=True)
+    with open(os.path.join(d, "300", "state.npz"), "r+b") as f:
+        f.truncate(10)
+    with pytest.raises(Exception):
+        ckpt.restore(d, tiny_state(), step=300)
+    assert os.path.isdir(os.path.join(d, "300"))   # NOT quarantined
+
+
+def test_restore_all_corrupt_raises_with_words(tmp_path):
+    from gansformer_tpu.train import checkpoint as ckpt
+    from tests.test_checkpoint_async import tiny_state
+
+    d = str(tmp_path / "ck")
+    ckpt.save(d, tiny_state(step=10), block=True)
+    with open(os.path.join(d, "10", "state.npz"), "r+b") as f:
+        f.truncate(5)
+    with pytest.raises(ValueError, match="decodes cleanly"):
+        ckpt.restore(d, tiny_state())
+
+
+def test_mismatched_template_walks_back_too(tmp_path):
+    """'torn/mismatched' (the satellite's words): a latest step whose
+    leaves don't fit the template walks back like a torn one."""
+    import jax.numpy as jnp
+
+    from gansformer_tpu.train import checkpoint as ckpt
+    from tests.test_checkpoint_async import (
+        assert_trees_equal, tiny_state)
+
+    d = str(tmp_path / "ck")
+    good = tiny_state(step=100)
+    ckpt.save(d, good, block=True)
+    bad = dataclasses.replace(tiny_state(step=200), w_avg=jnp.zeros(9))
+    ckpt.save(d, bad, block=True)
+    restored = ckpt.restore(d, tiny_state())
+    assert_trees_equal(good, restored)
+    assert os.path.isdir(os.path.join(d, "200.corrupt"))
+
+
+# --- bounded shutdown --------------------------------------------------------
+
+def test_single_slot_writer_wait_timeout_bounded():
+    from gansformer_tpu.utils.background import SingleSlotWriter
+
+    w = SingleSlotWriter("test/bounded")
+    gate = threading.Event()
+    w.submit(lambda: gate.wait(10.0))
+    t0 = time.perf_counter()
+    assert w.wait(timeout=0.2) is False        # wedged writer: bounded
+    assert time.perf_counter() - t0 < 2.0
+    assert w.close(timeout=0.05) is False      # close never raises
+    gate.set()
+    assert w.wait(timeout=5.0) is True
+
+
+def test_single_slot_writer_timeout_preserves_sticky_error():
+    from gansformer_tpu.utils.background import (
+        BackgroundWriteError, SingleSlotWriter)
+
+    w = SingleSlotWriter("test/bounded2")
+    gate = threading.Event()
+
+    def job():
+        gate.wait(10.0)
+        raise OSError("late failure")
+
+    w.submit(job)
+    assert w.close(timeout=0.05) is False      # timed out, no delivery
+    gate.set()
+    w.wait(reraise=False, timeout=5.0)
+    with pytest.raises(BackgroundWriteError, match="late failure"):
+        w.poll()                               # sticky error intact
+
+
+def test_loop_worker_wait_and_close_timeouts():
+    from gansformer_tpu.utils.background import LoopWorker
+
+    gate = threading.Event()
+    lw = LoopWorker(lambda: gate.wait(10.0), "test/lw").start()
+    assert lw.wait(timeout=0.05) is False
+    assert lw.close(timeout=0.05) is False
+    gate.set()
+    assert lw.wait(timeout=5.0) is True
+
+
+# --- elastic re-mesh ---------------------------------------------------------
+
+def _cfg(mesh=None):
+    from gansformer_tpu.core.config import MeshConfig
+    from tests.test_train import micro_cfg
+
+    cfg = micro_cfg()               # batch_size 8
+    return dataclasses.replace(cfg, mesh=mesh or MeshConfig())
+
+
+def test_elastic_pinned_axis_respected_when_it_fits():
+    from gansformer_tpu.core.config import MeshConfig
+
+    cfg, notes = resolve_elastic_mesh(_cfg(MeshConfig(data=2)), 2)
+    assert cfg.mesh.data == 2 and notes == []
+
+
+def test_elastic_pinned_axis_rewritten_to_all_devices():
+    from gansformer_tpu.core.config import MeshConfig
+
+    cfg, notes = resolve_elastic_mesh(_cfg(MeshConfig(data=2)), 1)
+    assert cfg.mesh.data == -1          # grows back on a wider claim
+    assert any("does not fit" in n for n in notes)
+
+
+def test_elastic_derived_axis_pins_largest_divisor():
+    cfg, notes = resolve_elastic_mesh(_cfg(), 3)   # batch 8 % 3 != 0
+    assert cfg.mesh.data == 2
+    assert any("does not divide" in n for n in notes)
+    cfg, notes = resolve_elastic_mesh(_cfg(), 8)
+    assert cfg.mesh.data == -1 and notes == []
+
+
+def test_elastic_fsdp_dropped_only_when_pinned_to_one():
+    from gansformer_tpu.core.config import MeshConfig
+
+    base = _cfg(MeshConfig(data=2, fsdp=True))
+    # shrink to 1 device: data -1 derives 1, fsdp kept (degrades to
+    # replicated placement per-leaf)
+    cfg, notes = resolve_elastic_mesh(base, 1)
+    assert cfg.mesh.data == -1 and cfg.mesh.fsdp
+    # derived axis pinned to a >1 divisor: fsdp kept
+    odd = dataclasses.replace(
+        _cfg(MeshConfig(data=-1, fsdp=True)),
+        train=dataclasses.replace(base.train, batch_size=6),
+        model=dataclasses.replace(base.model, mbstd_group_size=2))
+    cfg, notes = resolve_elastic_mesh(odd, 5)   # 6 % 5 != 0 → pin 3
+    assert cfg.mesh.data == 3 and cfg.mesh.fsdp
+    # a pin that lands on 1 (batch 7, 2 devices) must drop fsdp to
+    # stay expressible
+    prime = dataclasses.replace(
+        odd, train=dataclasses.replace(odd.train, batch_size=7,
+                                       pl_batch_shrink=1),
+        model=dataclasses.replace(odd.model, mbstd_group_size=1))
+    cfg, notes = resolve_elastic_mesh(prime, 2)
+    assert cfg.mesh.data == 1 and not cfg.mesh.fsdp
+    assert any("fsdp disabled" in n for n in notes)
+
+
+def test_elastic_model_axis_refused():
+    from gansformer_tpu.core.config import MeshConfig
+
+    base = _cfg(MeshConfig(data=1, model=2))
+    base = dataclasses.replace(
+        base, model=dataclasses.replace(base.model,
+                                        sequence_parallel=True))
+    with pytest.raises(ElasticMeshError, match="model"):
+        resolve_elastic_mesh(base, 1)
+
+
+# --- events ledger + availability -------------------------------------------
+
+def _ledger(run_dir, *recs):
+    for kind, fields in recs:
+        events.append_event(run_dir, kind, **fields)
+
+
+def test_events_roundtrip_torn_tolerant(tmp_path):
+    d = str(tmp_path)
+    _ledger(d, ("start", {"restart_index": 0, "downtime_s": 0.0}),
+            ("exit", {"cause": "crash", "exit_code": -9,
+                      "uptime_s": 10.0, "step": 1000}))
+    with open(events.events_path(d), "a") as f:
+        f.write('{"kind": "ex')            # SIGKILL mid-append
+    evs = events.read_events(d)
+    assert [e["kind"] for e in evs] == ["start", "exit"]
+
+
+def test_supervisor_events_schema_tolerates_and_reports_garbage(tmp_path):
+    """Schema lint: a torn FINAL line is the ledger's normal ending
+    (tolerated); mid-file garbage — torn lines or valid-JSON non-objects
+    — is reported, never a checker crash."""
+    from gansformer_tpu.analysis.telemetry_schema import (
+        check_supervisor_events)
+
+    d = str(tmp_path)
+    _ledger(d, ("start", {"restart_index": 0, "downtime_s": 0.0}))
+    with open(events.events_path(d), "a") as f:
+        f.write("null\n")                     # valid JSON, not an object
+        f.write('{"kind": "exit"\n')          # torn mid-file
+        f.write(json.dumps({"schema": 1, "kind": "exit", "time": 1.0,
+                            "pid": 1, "cause": "crash",
+                            "exit_code": 1}) + "\n")
+        f.write('{"kind": "st')               # torn FINAL line: tolerated
+    errs = check_supervisor_events(events.events_path(d))
+    assert any("not a JSON object" in e for e in errs)
+    assert any("not JSON" in e for e in errs)
+    assert not any(":5:" in e for e in errs)   # the torn tail is free
+
+
+def test_availability_summary(tmp_path):
+    d = str(tmp_path)
+    now = 1_000_000.0
+    _ledger(
+        d,
+        ("supervisor_start", {"max_restarts": 8, "time": now - 100}),
+        ("start", {"restart_index": 0, "downtime_s": 0.0,
+                   "time": now - 100}),
+        ("exit", {"cause": "preemption", "exit_code": 75,
+                  "uptime_s": 60.0, "step": 1000, "time": now - 40}),
+        ("start", {"restart_index": 1, "downtime_s": 20.0, "resume": True,
+                   "time": now - 20}),
+        ("exit", {"cause": "clean", "exit_code": 0, "uptime_s": 20.0,
+                  "step": 2000, "time": now}),
+        ("complete", {"restarts": 1, "step": 2000, "time": now}))
+    s = events.availability(events.read_events(d), now=now)
+    assert s["restarts"] == 1 and s["restarts_last_hour"] == 1
+    assert s["causes"] == {"preemption": 1, "clean": 1}
+    assert s["completed"] and not s["gave_up"]
+    assert abs(s["ratio"] - 80.0 / 100.0) < 1e-9
+    assert s["last_step"] == 2000
+
+
+# --- hang probe --------------------------------------------------------------
+
+def _write_beat(run_dir, idx, t, step=0, phase=None):
+    rec = {"process": idx, "pid": 1, "host": "h", "time": t,
+           "step": step, "kimg": step / 1000}
+    if phase:
+        rec["phase"] = phase
+    with open(os.path.join(run_dir, f"heartbeat-p{idx}.json"), "w") as f:
+        json.dump(rec, f)
+
+
+def test_probe_hang_verdicts(tmp_path):
+    d = str(tmp_path)
+    cfg = SupervisorConfig(heartbeat_max_age_s=10.0, startup_grace_s=30.0,
+                           max_step_skew=5)
+    t0 = 1000.0
+    # no beat yet, inside startup grace → healthy
+    assert probe_hang(d, t0, cfg, now=t0 + 20) is None
+    # no beat, grace exceeded → hang
+    assert "startup grace" in probe_hang(d, t0, cfg, now=t0 + 31)
+    # a STALE beat from the previous attempt must not convict this one
+    _write_beat(d, 0, t0 - 50)
+    assert "startup grace" in probe_hang(d, t0, cfg, now=t0 + 31)
+    # fresh beat → healthy; then it goes stale
+    _write_beat(d, 0, t0 + 5)
+    assert probe_hang(d, t0, cfg, now=t0 + 10) is None
+    assert "stale" in probe_hang(d, t0, cfg, now=t0 + 16)
+    # straggler: two fresh beats, step spread beyond max_step_skew
+    _write_beat(d, 0, t0 + 20, step=100)
+    _write_beat(d, 1, t0 + 20, step=200)
+    assert "skew" in probe_hang(d, t0, cfg, now=t0 + 21)
+
+
+def test_probe_hang_setup_beat_keeps_startup_grace(tmp_path):
+    """The loop beats once at setup BEFORE the first-dispatch compiles;
+    a supervisor judging that window against the steady-state heartbeat
+    budget would kill a healthy child mid-compile — the setup-phase
+    beat keeps the startup grace in force until a tick beat lands."""
+    d = str(tmp_path)
+    cfg = SupervisorConfig(heartbeat_max_age_s=10.0, startup_grace_s=30.0)
+    t0 = 1000.0
+    _write_beat(d, 0, t0 + 1, phase="setup")
+    # 25s of silence: stale by heartbeat budget, fine by startup grace
+    assert probe_hang(d, t0, cfg, now=t0 + 26) is None
+    # past the startup grace with still no tick beat → hang
+    assert "setup phase" in probe_hang(d, t0, cfg, now=t0 + 40)
+    # a tick beat ends the setup regime: heartbeat budget applies again
+    _write_beat(d, 0, t0 + 41)
+    assert probe_hang(d, t0, cfg, now=t0 + 50) is None
+    assert "stale" in probe_hang(d, t0, cfg, now=t0 + 52)
+    # the finalize beat (final snapshot + sync checkpoint window)
+    # restores the grace regime — an almost-finished child must not be
+    # killed as a hang mid-final-save
+    _write_beat(d, 0, t0 + 60, phase="finalize")
+    assert probe_hang(d, t0, cfg, now=t0 + 85) is None
+    assert "finalize phase" in probe_hang(d, t0, cfg, now=t0 + 95)
+
+
+def test_supervise_preempted_during_backoff_does_not_respawn(tmp_path):
+    """A SIGTERM landing between children (backoff sleep) must stop the
+    supervisor instead of spawning into a dying allocation."""
+    d = str(tmp_path / "run")
+    fired = {"n": 0}
+
+    def build_argv(resume, i):
+        fired["n"] += 1
+        assert i == 0, "respawned after preemption"
+        return [sys.executable, "-c", "raise SystemExit(2)"]
+
+    def log(msg):
+        # the "restart #…" line is emitted right before the backoff
+        # sleep — deliver the preemption notice exactly there (on the
+        # supervisor thread, where its handler is installed)
+        if msg.startswith("restart #"):
+            signal.raise_signal(signal.SIGTERM)
+
+    res = supervise(build_argv, d, FAST, log=log)
+    assert res["cause"] == "supervisor_preempted"
+    assert res["exit_code"] == events.EXIT_PREEMPTED
+    assert fired["n"] == 1
+    assert any(e["kind"] == "supervisor_preempted"
+               for e in events.read_events(d))
+
+
+def test_concurrent_same_step_saves_use_distinct_tmp_dirs(tmp_path):
+    """The preemption path can sync-save the step a timed-out async
+    writer is still writing: the tmp dir must be per-thread or the two
+    np.savez streams interleave into one torn file."""
+    from gansformer_tpu.train import checkpoint as ckpt
+    from tests.test_checkpoint_async import (
+        assert_trees_equal, tiny_state)
+
+    d = str(tmp_path / "ck")
+    st = tiny_state(step=500, scale=2.0)
+    gate = threading.Event()
+    seen = []
+
+    def hook(step):
+        seen.append(sorted(p for p in os.listdir(d)
+                           if p.startswith(".tmp")))
+        if len(seen) == 1:
+            # first (async) writer parks mid-write; a second thread (the
+            # loop thread in the preemption scenario) sync-saves the
+            # SAME step concurrently
+            t = threading.Thread(
+                target=lambda: ckpt.save(d, st, block=True))
+            t.start()
+            t.join()
+            gate.set()
+
+    try:
+        ckpt._WRITE_HOOK = hook
+        ckpt.save(d, st, block=False)
+        ckpt.wait(d)
+    finally:
+        ckpt._WRITE_HOOK = None
+    assert gate.is_set()
+    # the nested sync save saw BOTH tmp dirs, with distinct names
+    assert len(seen[1]) == 2 and len(set(seen[1])) == 2, seen
+    assert ckpt.latest_step(d) == 500
+    assert_trees_equal(st, ckpt.restore(d, tiny_state()))
+
+
+def test_quarantine_race_lost_to_peer_still_walks_back(tmp_path,
+                                                       monkeypatch):
+    """Multi-host resume: every process walks the same shared dir; the
+    quarantine-rename losers must walk back, not crash."""
+    from gansformer_tpu.train import checkpoint as ckpt
+    from tests.test_checkpoint_async import (
+        assert_trees_equal, tiny_state)
+
+    d = str(tmp_path / "ck")
+    good = tiny_state(step=100, scale=3.0)
+    ckpt.save(d, good, block=True)
+    ckpt.save(d, tiny_state(step=200), block=True)
+    p = os.path.join(d, "200", "state.npz")
+    with open(p, "r+b") as f:
+        f.truncate(10)
+
+    real_quarantine = ckpt._quarantine
+
+    def peer_wins(ckpt_dir, step):
+        real_quarantine(ckpt_dir, step)     # "the peer" renames first
+        return real_quarantine(ckpt_dir, step)  # our rename: src gone
+
+    monkeypatch.setattr(ckpt, "_quarantine", peer_wins)
+    restored = ckpt.restore(d, tiny_state())
+    assert_trees_equal(good, restored)
+
+
+# --- the supervisor itself (trivial no-jax children) -------------------------
+
+FAST = SupervisorConfig(max_restarts=3, backoff_base_s=0.05,
+                        backoff_max_s=0.2, poll_interval_s=0.05,
+                        startup_grace_s=60.0, hang_kill_grace_s=0.5)
+
+
+def _marker_child(tmp_path, first_exit):
+    """argv for a child that exits ``first_exit`` once, then 0."""
+    marker = str(tmp_path / "marker")
+    return [sys.executable, "-c",
+            f"import os, sys\n"
+            f"m = {marker!r}\n"
+            f"if not os.path.exists(m):\n"
+            f"    open(m, 'w').close(); sys.exit({first_exit})\n"
+            f"sys.exit(0)"]
+
+
+def test_supervise_restarts_crash_to_completion(tmp_path):
+    d = str(tmp_path / "run")
+    argv = _marker_child(tmp_path, 2)
+    res = supervise(lambda r, i: argv, d, FAST, log=lambda m: None)
+    assert res["ok"] and res["exit_code"] == 0 and res["restarts"] == 1
+    causes = [e["cause"] for e in events.read_events(d)
+              if e["kind"] == "exit"]
+    assert causes == ["crash", "clean"]
+    # telemetry family present and self-consistent
+    from gansformer_tpu.analysis.telemetry_schema import (
+        check_prom, check_supervise_metric_families,
+        check_supervisor_events)
+
+    prom = os.path.join(d, "supervisor.prom")
+    assert check_prom(prom) == []
+    assert check_supervise_metric_families(prom) == []
+    assert check_supervisor_events(events.events_path(d)) == []
+
+
+def test_supervise_classifies_preemption_code(tmp_path):
+    d = str(tmp_path / "run")
+    argv = _marker_child(tmp_path, events.EXIT_PREEMPTED)
+    res = supervise(lambda r, i: argv, d, FAST, log=lambda m: None)
+    assert res["ok"]
+    causes = [e["cause"] for e in events.read_events(d)
+              if e["kind"] == "exit"]
+    assert causes == ["preemption", "clean"]
+
+
+def test_supervise_gives_up_on_budget(tmp_path):
+    d = str(tmp_path / "run")
+    cfg = dataclasses.replace(FAST, max_restarts=1)
+    res = supervise(lambda r, i: [sys.executable, "-c", "raise SystemExit(3)"],
+                    d, cfg, log=lambda m: None)
+    assert not res["ok"] and res["exit_code"] == 1 and res["restarts"] == 1
+    evs = events.read_events(d)
+    assert any(e["kind"] == "give_up" for e in evs)
+    assert sum(1 for e in evs if e["kind"] == "exit") == 2
+
+
+def test_supervise_kills_hung_child(tmp_path):
+    d = str(tmp_path / "run")
+    cfg = dataclasses.replace(FAST, max_restarts=0, startup_grace_s=0.3)
+    t0 = time.time()
+    res = supervise(
+        lambda r, i: [sys.executable, "-c", "import time; time.sleep(60)"],
+        d, cfg, log=lambda m: None)
+    assert time.time() - t0 < 20.0
+    assert not res["ok"] and res["cause"] == "hang"
+    ex = [e for e in events.read_events(d) if e["kind"] == "exit"]
+    assert ex[0]["cause"] == "hang" and "hang_reason" in ex[0]
+
+
+# --- doctor availability section --------------------------------------------
+
+def _doctor(d, **kw):
+    from gansformer_tpu.cli.telemetry import run_doctor
+    from tests.test_doctor import NOW
+
+    return run_doctor(d, now=NOW, **kw)
+
+
+def _levels(report):
+    return {c["name"]: c["level"] for c in report["checks"]}
+
+
+def _detail(report, name):
+    return next(c["detail"] for c in report["checks"]
+                if c["name"] == name)
+
+
+def test_doctor_availability_grades(tmp_path):
+    from tests.test_doctor import NOW, synth_run_dir
+
+    # healthy supervised run → PASS with ratio
+    d = synth_run_dir(tmp_path, name="ok")
+    _ledger(d, ("start", {"restart_index": 0, "downtime_s": 0.0,
+                          "time": NOW - 100}),
+            ("exit", {"cause": "preemption", "exit_code": 75,
+                      "uptime_s": 90.0, "step": 1000, "time": NOW - 10}),
+            ("start", {"restart_index": 1, "downtime_s": 10.0,
+                       "time": NOW}))
+    rep = _doctor(d)
+    assert _levels(rep)["availability"] == "PASS"
+    assert "availability 90.0%" in _detail(rep, "availability")
+
+    # give-up → FAIL
+    d = synth_run_dir(tmp_path, name="gaveup")
+    _ledger(d, ("exit", {"cause": "crash", "exit_code": 1,
+                         "uptime_s": 5.0, "step": 0, "time": NOW}),
+            ("give_up", {"restarts": 8, "cause": "crash", "time": NOW}))
+    rep = _doctor(d)
+    assert _levels(rep)["availability"] == "FAIL" and not rep["ok"]
+
+    # restart storm → WARN
+    d = synth_run_dir(tmp_path, name="storm")
+    for i in range(8):
+        _ledger(d, ("start", {"restart_index": i + 1, "downtime_s": 1.0,
+                              "time": NOW - 10 * i}),
+                ("exit", {"cause": "crash", "exit_code": 1,
+                          "uptime_s": 1.0, "step": 0,
+                          "time": NOW - 10 * i}))
+    rep = _doctor(d)
+    assert _levels(rep)["availability"] == "WARN"
+    assert "storm" in _detail(rep, "availability")
+
+    # unclassified cause → WARN
+    d = synth_run_dir(tmp_path, name="odd")
+    _ledger(d, ("exit", {"cause": "gremlins", "exit_code": 1,
+                         "uptime_s": 1.0, "step": 0, "time": NOW}))
+    rep = _doctor(d)
+    assert _levels(rep)["availability"] == "WARN"
+    assert "unclassified" in _detail(rep, "availability")
+
+    # no ledger → no availability section (legacy runs unchanged)
+    d = synth_run_dir(tmp_path, name="plain")
+    rep = _doctor(d)
+    assert "availability" not in _levels(rep)
+
+
+# --- data-stream resume alignment -------------------------------------------
+
+def test_synthetic_batches_start_batch_aligns():
+    import numpy as np
+
+    from gansformer_tpu.data.dataset import SyntheticDataset
+
+    ds = SyntheticDataset(resolution=8, num_images=100)
+    full = ds.batches(4, seed=7)
+    ref = [next(full) for _ in range(6)]
+    resumed = ds.batches(4, seed=7, start_batch=3)
+    for want in ref[3:]:
+        got = next(resumed)
+        assert np.array_equal(want["image"], got["image"])
+
+
+def test_npz_batches_start_batch_aligns(tmp_path):
+    import numpy as np
+
+    from gansformer_tpu.data.dataset import NpzDataset
+
+    path = str(tmp_path / "d.npz")
+    np.savez(path, images=np.random.RandomState(0).randint(
+        0, 255, (32, 8, 8, 3), dtype=np.uint8))
+    ds = NpzDataset(path)
+    full = ds.batches(4, seed=3)
+    ref = [next(full) for _ in range(5)]
+    resumed = ds.batches(4, seed=3, start_batch=2)
+    for want in ref[2:]:
+        assert np.array_equal(want["image"], next(resumed)["image"])
+
+
+# --- slow: the scripted fault plan + elastic restarts ------------------------
+
+def _write_micro_config(tmp_path, total_kimg, mesh_data=None):
+    from gansformer_tpu.core.config import MeshConfig
+    from tests.test_train import micro_cfg
+
+    cfg = micro_cfg(attention="simplex", batch=8)
+    cfg = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(
+            cfg.train, total_kimg=total_kimg, kimg_per_tick=1,
+            snapshot_ticks=1, image_snapshot_ticks=0,
+            device_time_ticks=0),
+        mesh=MeshConfig(data=mesh_data) if mesh_data else cfg.mesh)
+    p = str(tmp_path / "config.json")
+    with open(p, "w") as f:
+        f.write(cfg.to_json())
+    return cfg, p
+
+
+def _child_env(devices=8):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices}")
+    return env
+
+
+def _loss_by_kimg(run_dir):
+    out = {}
+    with open(os.path.join(run_dir, "stats.jsonl")) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "Progress/kimg" in rec and "Loss/G" in rec:
+                out[round(rec["Progress/kimg"], 3)] = (
+                    rec["Loss/G"], rec.get("Loss/D"))
+    return out
+
+
+@pytest.mark.slow  # four subprocess training runs (compile-cache warm)
+def test_scripted_fault_plan_matches_uninterrupted_run(tmp_path):
+    """The ISSUE 12 acceptance plan: kill -9 mid-checkpoint → auto-resume
+    → SIGTERM preemption at a tick boundary → auto-resume → complete,
+    all under gansformer-supervise with zero intervention — and the
+    supervised run's per-tick losses equal an uninterrupted run's."""
+    cfg, cfg_path = _write_micro_config(tmp_path, total_kimg=4)
+
+    # reference: uninterrupted run, same config, plain train CLI
+    ref_dir = str(tmp_path / "ref")
+    r = subprocess.run(
+        [sys.executable, "-m", "gansformer_tpu.cli.train",
+         "--config", cfg_path, "--run-dir", ref_dir],
+        env=_child_env(), cwd=ROOT, capture_output=True, text=True,
+        timeout=900)
+    assert r.returncode == 0, r.stderr[-3000:]
+
+    # supervised: crash at the step-2000 checkpoint write, preemption
+    # notice at the step-3000 tick boundary
+    sup_dir = str(tmp_path / "sup")
+    r = subprocess.run(
+        [sys.executable, "-m", "gansformer_tpu.cli.supervise",
+         "--run-dir", sup_dir, "--max-restarts", "4",
+         "--poll-interval", "0.5", "--backoff-base", "0.1",
+         "--startup-grace", "600", "--heartbeat-max-age", "600",
+         "--fault", "sigkill@ckpt_mid_write:step=2000",
+         "--fault", "sigterm@tick:step=3000",
+         "--", "--config", cfg_path],
+        env=_child_env(), cwd=ROOT, capture_output=True, text=True,
+        timeout=900)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-3000:])
+
+    # the ledger tells the story: crash, preemption, clean — in order
+    causes = [e["cause"] for e in events.read_events(sup_dir)
+              if e["kind"] == "exit"]
+    assert causes == ["crash", "preemption", "clean"], causes
+    fired = [json.loads(l) for l in
+             open(os.path.join(sup_dir, "faults_fired.jsonl"))]
+    assert {f["key"] for f in fired} == {
+        "sigkill@ckpt_mid_write:step=2000", "sigterm@tick:step=3000"}
+
+    # per-tick loss parity: the supervised run's trajectory is
+    # tick-for-tick identical to the uninterrupted one (bit-exact
+    # restore + iteration-indexed rng + start_batch data alignment)
+    ref_losses = _loss_by_kimg(ref_dir)
+    sup_losses = _loss_by_kimg(sup_dir)
+    assert set(ref_losses) <= set(sup_losses)
+    for k, v in ref_losses.items():
+        assert sup_losses[k] == v, (k, v, sup_losses[k])
+
+    # the doctor grades the whole thing PASS (availability section
+    # included) with no FAILs
+    from gansformer_tpu.cli.telemetry import run_doctor
+
+    report = run_doctor(sup_dir)
+    assert report["ok"], report
+    lv = {c["name"]: c["level"] for c in report["checks"]}
+    assert lv["availability"] == "PASS"
+
+
+@pytest.mark.slow  # three subprocess training runs at 2/1/2 devices
+def test_elastic_restart_across_device_counts(tmp_path):
+    """2-device run → resume on 1 device (re-mesh + re-shard) → resume
+    on 2 devices again (grows back) — the forced-virtual-CPU elastic
+    acceptance test."""
+    cfg, cfg_path = _write_micro_config(tmp_path, total_kimg=1,
+                                        mesh_data=2)
+    d = str(tmp_path / "run")
+
+    def run(devices, total_kimg, resume):
+        argv = [sys.executable, "-m", "gansformer_tpu.cli.train",
+                "--config", cfg_path, "--run-dir", d,
+                "--total-kimg", str(total_kimg)]
+        if resume:
+            argv.append("--resume")
+        return subprocess.run(argv, env=_child_env(devices), cwd=ROOT,
+                              capture_output=True, text=True,
+                              timeout=900)
+
+    r = run(2, 1, resume=False)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "'data': 2" in open(os.path.join(d, "log.txt")).read()
+
+    r = run(1, 2, resume=True)
+    assert r.returncode == 0, r.stderr[-3000:]
+    log = open(os.path.join(d, "log.txt")).read()
+    assert "re-meshed" in log and "resumed from step 1000" in log
+    elastic = [e for e in events.read_events(d) if e["kind"] == "elastic"]
+    assert elastic and elastic[0]["n_devices"] == 1
+
+    r = run(2, 3, resume=True)
+    assert r.returncode == 0, r.stderr[-3000:]
+    log = open(os.path.join(d, "log.txt")).read()
+    assert "resumed from step 2000" in log
+    # back on 2 devices: the rewritten data=-1 mesh derives 2 again
+    assert log.rstrip().rsplit("mesh: ", 1)[-1].startswith("{'data': 2")
+
+    from gansformer_tpu.train import checkpoint as ckpt
+
+    assert ckpt.latest_step(os.path.join(d, "checkpoints")) == 3000
