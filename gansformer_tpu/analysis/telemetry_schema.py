@@ -150,13 +150,37 @@ def check_metric_families(path: str) -> List[str]:
     return errors
 
 
-def check_serve_metric_families(path: str) -> List[str]:
-    """Serving SLO families (ISSUE 10): a service's ``telemetry.prom``
-    must carry the queue-depth / batch-fill / latency histograms and
-    the dispatch counters — absence means the SLO wiring rotted, and a
-    load-test artifact without them is unreviewable.  Values-aware the
-    same way the device-truth check is: traffic served implies latency
-    samples landed."""
+# Serving health vocabulary (ISSUE 13) — the ONE jax-free home both
+# CLI graders (gansformer-serve --healthcheck, the doctor's serving
+# section) import, so the probe and the doctor can't diverge on the
+# same prom file.  serve/service.py keeps a private mirror (importing
+# analysis from the serving hot path would invert the layering).
+SERVE_HEALTH_NAMES = {0: "ready", 1: "degraded", 2: "unhealthy",
+                      3: "closed"}
+
+
+def serve_dead_with_work(alive, queue_depth) -> bool:
+    """A dispatcher that is down while requests sit queued: those
+    tickets are hung — the one liveness verdict that must outrank a
+    merely 'degraded' health state."""
+    return alive == 0.0 and (queue_depth or 0.0) > 0
+
+
+def check_serve_metric_families(path: str,
+                                expect_overload: bool = False) -> List[str]:
+    """Serving SLO families (ISSUE 10 + 13): a service's
+    ``telemetry.prom`` must carry the queue-depth / batch-fill /
+    latency histograms, the dispatch counters, and the robustness
+    family — absence means the SLO wiring rotted, and a load-test
+    artifact without them is unreviewable.  Values-aware the same way
+    the device-truth check is: traffic served implies latency samples
+    landed, and ``expect_overload=True`` (set by callers that DROVE
+    overload traffic, e.g. the chaos loadtest) implies the shed counter
+    moved — a bound-hitting burst with zero sheds means admission
+    control rotted into unbounded queueing.  (Overload is declared by
+    the caller, not inferred from queue-depth values: a healthy queue
+    may legitimately fill to its bound and drain without ever refusing
+    a submit.)"""
     from gansformer_tpu.obs.registry import parse_prom_values
 
     vals = parse_prom_values(path)
@@ -165,7 +189,14 @@ def check_serve_metric_families(path: str) -> List[str]:
                  "serve_e2e_ms_count", "serve_requests_total",
                  "serve_images_total", "serve_map_dispatch_total",
                  "serve_synth_dispatch_total",
-                 "serve_wcache_hits_total", "serve_wcache_misses_total"):
+                 "serve_wcache_hits_total", "serve_wcache_misses_total",
+                 # the ISSUE 13 robustness family — materialized at
+                 # service init, so absence always means rotted wiring
+                 "serve_shed_total", "serve_expired_total",
+                 "serve_cancelled_total",
+                 "serve_dispatcher_restarts_total",
+                 "serve_health_state", "serve_dispatcher_alive",
+                 "serve_queue_bound", "serve_queue_depth_now"):
         if name not in vals:
             errors.append(f"{path}: missing serve/* family member "
                           f"{name} (is the serving telemetry wired?)")
@@ -173,6 +204,11 @@ def check_serve_metric_families(path: str) -> List[str]:
             vals.get("serve_e2e_ms_count", 0.0) <= 0:
         errors.append(f"{path}: requests were served but no "
                       f"serve_e2e_ms latency samples landed")
+    if expect_overload and vals.get("serve_shed_total", 0.0) <= 0:
+        errors.append(f"{path}: overload traffic was driven (bound "
+                      f"{vals.get('serve_queue_bound', 0.0):g}) but "
+                      f"serve_shed_total never moved — is admission "
+                      f"control wired?")
     return errors
 
 

@@ -13,6 +13,18 @@ Modes:
                  ``--out``, print a JSON summary line.
 * ``--warm-only`` — populate/validate the manifest and exit (the
                  deploy-time pre-bake step).
+* ``--healthcheck PATH`` — grade a service's exported telemetry.prom
+                 (file, or dir containing one) WITHOUT touching the
+                 accelerator: prints {state, …} JSON, exit 0 unless
+                 the service is unhealthy (circuit breaker tripped /
+                 dispatcher dead with work queued) — the probe a
+                 liveness check or babysitter scripts against the
+                 robustness floor (ISSUE 13).
+
+The demo service runs under the full robustness floor: bounded
+admission (``--queue-depth``), optional per-request deadlines
+(``--deadline-s``), supervised dispatcher restart, and a SIGTERM →
+graceful-drain hook (``--grace-s`` window).
 
 No network listener here deliberately: the service core is a Python
 API (``serve.GenerationService``); the transport in front of it is a
@@ -25,6 +37,51 @@ import argparse
 import json
 import os
 import time
+
+def healthcheck(path: str, max_age_s=None) -> int:
+    """Grade an exported ``telemetry.prom``: 0 = ready/degraded (and no
+    dead-dispatcher-with-work signal), 1 = unhealthy/unreadable — or
+    STALE when ``max_age_s`` is given and the snapshot file is older
+    (a frozen last-good export must not pass a liveness probe
+    forever).  Never imports jax — safe to script from probes on the
+    serving host."""
+    from gansformer_tpu.analysis.telemetry_schema import (
+        SERVE_HEALTH_NAMES, serve_dead_with_work)
+    from gansformer_tpu.obs.registry import parse_prom_values
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.prom")
+    if not os.path.exists(path):
+        print(json.dumps({"state": "unknown", "ok": False,
+                          "error": f"{path}: missing"}))
+        return 1
+    vals = parse_prom_values(path)
+    code = vals.get("serve_health_state")
+    if code is None:
+        print(json.dumps({"state": "unknown", "ok": False, "prom": path,
+                          "error": "no serve_health_state gauge — not a "
+                                   "serving telemetry.prom"}))
+        return 1
+    snapshot_age = time.time() - os.path.getmtime(path)
+    alive = vals.get("serve_dispatcher_alive")
+    depth = vals.get("serve_queue_depth_now", 0.0)
+    state = SERVE_HEALTH_NAMES.get(int(code), "unknown")
+    stale = max_age_s is not None and snapshot_age > max_age_s
+    if stale:
+        state = "stale"
+    out = {"state": state, "prom": path,
+           "snapshot_age_s": round(snapshot_age, 1), "ok":
+           state in ("ready", "degraded", "closed")
+           and not serve_dead_with_work(alive, depth),
+           "dispatcher_alive": alive, "queue_depth": depth,
+           "queue_bound": vals.get("serve_queue_bound"),
+           "dispatcher_restarts":
+               vals.get("serve_dispatcher_restarts_total"),
+           "shed_total": vals.get("serve_shed_total"),
+           "expired_total": vals.get("serve_expired_total"),
+           "cancelled_total": vals.get("serve_cancelled_total")}
+    print(json.dumps(out, sort_keys=True))
+    return 0 if out["ok"] else 1
 
 
 def main(argv=None) -> int:
@@ -57,7 +114,27 @@ def main(argv=None) -> int:
                    help="populate/validate the manifest and exit")
     p.add_argument("--wcache", type=int, default=4096,
                    help="w-cache capacity (entries)")
+    p.add_argument("--queue-depth", type=int, default=256,
+                   help="admission queue bound (over-depth submits shed "
+                        "with a typed Overloaded)")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="per-request deadline; expired requests drop "
+                        "before dispatch")
+    p.add_argument("--grace-s", type=float, default=30.0,
+                   help="drain grace window for close()/SIGTERM")
+    p.add_argument("--healthcheck", default=None, metavar="PROM",
+                   help="grade a service telemetry.prom (file or dir) "
+                        "and exit — no accelerator touched")
+    p.add_argument("--health-max-age", type=float, default=None,
+                   help="with --healthcheck: fail when the prom "
+                        "snapshot is older than this many seconds "
+                        "(liveness probes; default: age reported, not "
+                        "judged — archived artifacts stay gradeable)")
     args = p.parse_args(argv)
+
+    if args.healthcheck:
+        return healthcheck(args.healthcheck,
+                           max_age_s=args.health_max_age)
 
     import jax
     import numpy as np
@@ -126,8 +203,15 @@ def main(argv=None) -> int:
         universe = np.arange(1, 64)
         pz = 1.0 / universe ** 1.1
         seeds = rng.choice(universe, size=args.images, p=pz / pz.sum())
-        with GenerationService(programs,
-                               wcache_capacity=args.wcache) as svc:
+        # the demo submits its whole request list unpaced, so the
+        # bound must sit above it — shedding the demo's own burst
+        # would be admission control arguing with the argument parser
+        svc = GenerationService(programs, wcache_capacity=args.wcache,
+                                max_queue_depth=max(args.queue_depth,
+                                                    args.images + 1),
+                                default_deadline_s=args.deadline_s)
+        svc.install_signal_drain(grace_s=args.grace_s)
+        try:
             t0 = time.perf_counter()
             first = svc.submit(int(seeds[0]), psi=args.psi)
             first.result(timeout=600)
@@ -137,6 +221,9 @@ def main(argv=None) -> int:
                        for s in seeds[1:]]
             imgs = [first.result()] + [t.result(timeout=600)
                                        for t in tickets]
+            summary["health"] = svc.health()
+        finally:
+            svc.close(timeout=args.grace_s)
         save_image_grid(np.stack(imgs),
                         os.path.join(out_dir, "served_grid.png"))
         snap = telemetry.get_registry().snapshot()

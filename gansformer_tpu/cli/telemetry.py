@@ -14,11 +14,14 @@ Four subcommands over the files the train loop writes
               device-time vs wall-clock MFU, wall-vs-device divergence,
               data-wait fraction, queue depths, retraces, HBM headroom,
               heartbeat staleness + per-process step skew, restart
-              count, and — when a supervisor ledger exists — the
+              count, — when a supervisor ledger exists — the
               availability section (ISSUE 12: exit causes, restart
-              storms, uptime ratio, give-up verdicts).  PASS/WARN/FAIL
-              lines; --json for the machine-readable form; exit 0 iff
-              no FAIL.
+              storms, uptime ratio, give-up verdicts), and — when
+              serve/* telemetry or a serve_chaos.json artifact exists —
+              the serving section (ISSUE 13: circuit breaker, dead
+              dispatcher, shed rate, queue saturation, hung chaos
+              tickets).  PASS/WARN/FAIL lines; --json for the
+              machine-readable form; exit 0 iff no FAIL.
 
 Examples
 --------
@@ -183,7 +186,8 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
                expected: Optional[int] = None,
                max_step_skew: Optional[int] = None,
                now: Optional[float] = None,
-               max_restarts_per_hour: float = 6.0) -> dict:
+               max_restarts_per_hour: float = 6.0,
+               max_shed_rate: float = 0.01) -> dict:
     """The run-health report as a pure-ish dict (rendered by
     ``render_doctor``; archived verbatim by ``--json``).
 
@@ -430,6 +434,100 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
     else:
         check("restarts", "PASS", "no restarts recorded")
 
+    # -- serving (ISSUE 13) -------------------------------------------------
+    # Graded only when serve/* telemetry is present (a service's
+    # telemetry.prom, or a run dir a load test wrote into): FAIL on a
+    # tripped circuit breaker or a dispatcher dead with work queued,
+    # WARN on shed rate > max_shed_rate or a saturated admission queue.
+    from gansformer_tpu.analysis.telemetry_schema import (
+        serve_dead_with_work)
+
+    serve_health = tele.gauge("serve/health_state")
+    serve_reqs = tele.counter("serve/requests_total")
+    chaos_path = os.path.join(run_dir, "serve_chaos.json")
+    chaos_present = os.path.exists(chaos_path)
+    if serve_health is not None or serve_reqs is not None:
+        alive = tele.gauge("serve/dispatcher_alive")
+        depth = tele.gauge("serve/queue_depth_now") or 0.0
+        bound = tele.gauge("serve/queue_bound")
+        s_restarts = tele.counter("serve/dispatcher_restarts_total") or 0.0
+        shed = tele.counter("serve/shed_total") or 0.0
+        reqs = serve_reqs or 0.0
+        shed_rate = shed / max(shed + reqs, 1.0)
+        bits = ("{} request(s), shed {} ({:.1%}), {} dispatcher "
+                "restart(s), queue {}/{}".format(
+                    int(reqs), int(shed), shed_rate, int(s_restarts),
+                    int(depth), "?" if bound is None else int(bound)))
+        if serve_health == 2.0:
+            check("serving", "FAIL",
+                  f"service UNHEALTHY (circuit breaker tripped or "
+                  f"failed drain) — needs a restart; {bits}")
+        elif serve_dead_with_work(alive, depth):
+            check("serving", "FAIL",
+                  f"dispatcher dead with {int(depth)} request(s) still "
+                  f"queued — tickets are hung; {bits}")
+        elif shed_rate > max_shed_rate and not chaos_present:
+            # a serve_chaos.json beside the telemetry means the
+            # overload was DELIBERATELY driven — shedding is the drill
+            # working, not a capacity alarm
+            check("serving", "WARN",
+                  f"shed rate {shed_rate:.1%} > {max_shed_rate:.0%} — "
+                  f"sustained overload (scale out or raise the queue "
+                  f"bound); {bits}")
+        elif bound and depth >= bound:
+            check("serving", "WARN",
+                  f"admission queue saturated — the next submit sheds; "
+                  f"{bits}")
+        else:
+            check("serving", "PASS",
+                  bits
+                  + (" (overload deliberately driven — chaos drill)"
+                     if chaos_present and shed_rate > max_shed_rate
+                     else "")
+                  + (" (degraded)" if serve_health == 1.0 else "")
+                  + (" (closed cleanly)" if serve_health == 3.0
+                     else ""))
+
+    # chaos/loadtest artifacts beside the telemetry, when present
+    if chaos_present:
+        try:
+            with open(chaos_path) as f:
+                chaos = json.load(f)
+        except ValueError:
+            chaos = None
+        if not isinstance(chaos, dict):
+            check("serve_chaos", "WARN",
+                  "serve_chaos.json present but not a JSON object")
+        else:
+            cbits = ("shed {:.1%}, expired {:.1%}, p99-under-overload "
+                     "{} ms, {} restart(s), recovery {} ms".format(
+                         chaos.get("shed_rate", 0.0),
+                         chaos.get("expired_rate", 0.0),
+                         chaos.get("p99_ms_under_overload"),
+                         int(chaos.get("dispatcher_restarts", 0)),
+                         chaos.get("recovery_ms")))
+            chaos_state = (chaos.get("health") or {}).get("state")
+            if chaos.get("hung_tickets", 0):
+                check("serve_chaos", "FAIL",
+                      f"{chaos['hung_tickets']} HUNG ticket(s) in the "
+                      f"chaos drill — a recovery path leaks requests; "
+                      f"{cbits}")
+            elif chaos_state == "unhealthy":
+                # the drill's own health snapshot (its prom may live in
+                # a separate file the telemetry accessor never reads)
+                check("serve_chaos", "FAIL",
+                      f"chaos drill left the service UNHEALTHY "
+                      f"(breaker tripped / failed drain) — "
+                      f"{(chaos.get('health') or {}).get('reasons')}; "
+                      f"{cbits}")
+            elif chaos.get("crash_at_batch") and \
+                    chaos.get("dispatcher_restarts", 0) < 1:
+                check("serve_chaos", "WARN",
+                      f"chaos drill recorded no dispatcher restart — "
+                      f"the injected crash never fired; {cbits}")
+            else:
+                check("serve_chaos", "PASS", cbits)
+
     # -- device phase table (informational) ---------------------------------
     phase_ms = sorted(((k.split("/", 2)[2], v)
                        for k, v in tele.gauges.items()
@@ -503,6 +601,9 @@ def main(argv=None) -> None:
                    help="restart-storm threshold for the availability "
                         "section (supervisor ledger restarts in the "
                         "last hour above this → WARN)")
+    d.add_argument("--max-shed-rate", type=float, default=0.01,
+                   help="serving-section shed-rate threshold (above "
+                        "this → WARN)")
 
     args = p.parse_args(argv)
 
@@ -526,7 +627,8 @@ def main(argv=None) -> None:
         report = run_doctor(run_dir, max_age_s=args.max_age,
                             expected=args.expected,
                             max_step_skew=args.max_skew,
-                            max_restarts_per_hour=args.max_restarts_hour)
+                            max_restarts_per_hour=args.max_restarts_hour,
+                            max_shed_rate=args.max_shed_rate)
         if args.json_out:
             with open(args.json_out, "w") as f:
                 json.dump(report, f, indent=1, sort_keys=True)

@@ -11,7 +11,12 @@ Four layers, bottom-up:
 * ``cache``     — LRU w-cache keyed by (seed, label): repeat /
   interpolation / style-mix traffic skips the mapping network.
 * ``service``   — continuous-batching request queue + dispatcher thread
-  with queue-depth / batch-fill / latency SLO telemetry.
+  with queue-depth / batch-fill / latency SLO telemetry, under the
+  ISSUE 13 robustness floor: bounded admission (``Overloaded``),
+  per-request deadlines (``Expired``), client-cancel (``Cancelled``),
+  supervised dispatcher restart with a circuit breaker
+  (``ServiceUnhealthy``), bucket quarantine, ``health()`` states, and
+  graceful drain (``ServiceClosed``).
 
 ``cli/serve.py`` (``gansformer-serve``) and
 ``scripts/loadtest_serve.py`` sit on top; ``docs/serving.md`` is the
@@ -23,6 +28,7 @@ from gansformer_tpu.serve.programs import (  # noqa: F401
     DEFAULT_BUCKETS, GeneratorBundle, ServePrograms, bucket_for,
     generator_fns, init_generator, load_generator)
 from gansformer_tpu.serve.service import (  # noqa: F401
-    GenerationService, Ticket)
+    Cancelled, Expired, GenerationService, Overloaded, ServeError,
+    ServiceClosed, ServiceUnhealthy, Ticket)
 from gansformer_tpu.serve.warmstart import (  # noqa: F401
     default_manifest_dir)

@@ -155,6 +155,10 @@ class ServePrograms:
         self.warm_start_enabled = warm_start and manifest_dir is not None
         self._fns = generator_fns(bundle.cfg)
         self._compiled: Dict[Tuple[str, int], Any] = {}
+        # THIS instance's manifest traffic (the global counters span
+        # every service a process ever ran — health() needs its own)
+        self.warm_hits = 0
+        self.manifest_stale = 0
         self._model_json = json.dumps(
             dataclasses.asdict(bundle.cfg.model), sort_keys=True)
         # explicit zeros for the schema lint (see serve/service.py)
@@ -193,6 +197,12 @@ class ServePrograms:
 
     # -- compile / warm start ------------------------------------------------
 
+    def is_compiled(self, kind: str, bucket: int) -> bool:
+        """Whether this (kind, bucket) executable is already
+        materialized — the serving hang watchdog widens its budget for
+        batches that will pay a lazy cold compile."""
+        return (kind, bucket) in self._compiled
+
     def _get(self, kind: str, bucket: int) -> Any:
         import jax
 
@@ -204,8 +214,12 @@ class ServePrograms:
         key = f"{kind}_b{bucket}"
         fp = warmstart.fingerprint(self._model_json, kind, bucket)
         if self.warm_start_enabled:
+            stale0 = telemetry.counter("serve/manifest_stale_total").value
             compiled = warmstart.load_executable(self.manifest_dir, key, fp)
+            self.manifest_stale += int(telemetry.counter(
+                "serve/manifest_stale_total").value - stale0)
             if compiled is not None:
+                self.warm_hits += 1
                 self._compiled[ck] = compiled
                 return compiled
         fn = getattr(self._fns, kind)

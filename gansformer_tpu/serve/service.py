@@ -14,6 +14,42 @@ Continuous batching: the queue drains whenever the device is free; a
 batch is NEVER held for stragglers beyond the fill wait, and oversize
 backlogs chunk at the max bucket per iteration.
 
+Robustness floor (ISSUE 13) — the serving half of the availability
+story the supervisor (ISSUE 12) started for training:
+
+* **Admission control** — the queue is BOUNDED (``max_queue_depth``);
+  an over-depth ``submit`` raises a typed ``Overloaded`` immediately
+  (``serve/shed_total``) instead of queueing unboundedly.  Under
+  overload the service degrades predictably: p50/p99 stay meaningful
+  because the queue can't grow past the bound.
+* **Deadlines** — per-request (``submit(deadline_s=…)`` or the
+  service-wide ``default_deadline_s``); an expired ticket is dropped at
+  pop time BEFORE dispatch (never padded into a bucket) and resolved
+  with a typed ``Expired`` error (``serve/expired_total``).  A client
+  whose ``result(timeout)`` raised marks its ticket CANCELLED, so the
+  dispatcher skips the orphaned work too (``serve/cancelled_total``).
+* **Self-healing dispatch** — a supervisor thread restarts a crashed
+  (or hung: ``hang_after_s``) dispatcher under progress-reset bounded
+  backoff (the exit-classification/backoff shape of
+  ``supervise/supervisor.py`` at serving time scale), failing the
+  in-flight batch instead of hanging it; after ``max_dispatcher_restarts``
+  back-to-back deaths the CIRCUIT BREAKER trips — queued tickets fail
+  with ``ServiceUnhealthy``, new submits are refused, ``health()``
+  reports unhealthy.
+* **Bucket quarantine** — ``quarantine_after`` consecutive synthesis
+  failures on one batch bucket quarantine it; later batches route to
+  the next-larger bucket (the largest bucket is never quarantined —
+  there must always be a route).
+* **Graceful drain** — ``close()`` (and the SIGTERM hook
+  ``install_signal_drain``) stops admitting, serves what's queued
+  within the grace window, then fails the rest with ``ServiceClosed``;
+  ``serve/queue_depth_now`` returns to 0 and no service thread leaks.
+* **Fault injection** — ``supervise/faults.py`` code points
+  ``serve_dispatch`` / ``serve_map`` / ``serve_fetch`` /
+  ``serve_fulfill`` (coords: monotonic ``batch``, plus ``n``/``bucket``)
+  so every recovery path above is deterministically exercised by tier-1
+  tests and ``scripts/loadtest_serve.py --chaos``.
+
 The dispatch loop is under the ``hot-loop-sync`` lint discipline
 (analysis/rules/hot_loop.py): the only host syncs in the ``while`` body
 live inside ``with span("serve_fetch")`` — the serving twin of the
@@ -26,52 +62,128 @@ SLO telemetry (obs/registry → ``telemetry.prom``):
 ``serve/batch_ms`` histogram (dispatch+fetch), counters
 ``serve/requests_total`` / ``serve/images_total`` /
 ``serve/map_dispatch_total`` / ``serve/synth_dispatch_total`` and the
-w-cache pair, plus the LoopWorker's ``serve/dispatch_heartbeat``.
+w-cache pair, plus the robustness family: ``serve/shed_total``,
+``serve/expired_total``, ``serve/cancelled_total``,
+``serve/dispatcher_restarts_total``, ``serve/bucket_quarantined_total``,
+gauges ``serve/health_state`` (0 ready / 1 degraded / 2 unhealthy /
+3 closed-cleanly), ``serve/dispatcher_alive``, ``serve/queue_bound``,
+and the LoopWorker's ``serve/dispatch_heartbeat``.
 """
 
 from __future__ import annotations
 
+import signal
 import threading
 import time
 from collections import deque
-from typing import List, Optional
+from typing import Dict, List, Optional, Set
 
 import numpy as np
 
 from gansformer_tpu.obs import registry as telemetry
 from gansformer_tpu.obs.spans import span
 from gansformer_tpu.serve.cache import WCache, wcache_key
-from gansformer_tpu.serve.programs import ServePrograms, bucket_for
+from gansformer_tpu.serve.programs import ServePrograms
+from gansformer_tpu.supervise import faults
 from gansformer_tpu.utils.background import LoopWorker
+
+HEALTH_READY, HEALTH_DEGRADED, HEALTH_UNHEALTHY, HEALTH_CLOSED = \
+    0, 1, 2, 3
+# keep in sync with analysis/telemetry_schema.SERVE_HEALTH_NAMES (the
+# CLI graders' shared copy) — mirrored here so the serving hot path
+# does not import the analysis package
+_HEALTH_NAMES = {HEALTH_READY: "ready", HEALTH_DEGRADED: "degraded",
+                 HEALTH_UNHEALTHY: "unhealthy",
+                 HEALTH_CLOSED: "closed"}
+
+
+class ServeError(RuntimeError):
+    """Base of the typed serving outcomes; ``Ticket.result`` raises
+    these DIRECTLY (not wrapped) so callers can catch by class."""
+
+
+class Overloaded(ServeError):
+    """Admission queue at its bound — the request was shed at submit."""
+
+
+class Expired(ServeError):
+    """The request's deadline passed before dispatch."""
+
+
+class Cancelled(ServeError):
+    """The client abandoned the ticket (``cancel()`` / result timeout)."""
+
+
+class ServiceUnhealthy(ServeError):
+    """Circuit breaker open (dispatcher restart budget exhausted)."""
+
+
+class ServiceClosed(ServeError):
+    """The service closed/drained before this ticket could be served."""
 
 
 class Ticket:
-    """One submitted request; ``result()`` blocks until fulfilled."""
+    """One submitted request; ``result()`` blocks until fulfilled.
 
-    __slots__ = ("seed", "psi", "label", "t_submit", "t_done",
-                 "_event", "_image", "_error")
+    Terminal states: ``done`` (image), ``failed`` (error), ``cancelled``
+    (client abandoned).  Transitions are one-shot — a late ``_fulfill``
+    against a cancelled ticket is a no-op, so the cancel/dispatch race
+    is benign by construction."""
 
-    def __init__(self, seed: int, psi: float, label):
+    __slots__ = ("seed", "psi", "label", "t_submit", "t_done", "deadline",
+                 "_event", "_image", "_error", "_state", "_lock")
+
+    def __init__(self, seed: int, psi: float, label,
+                 deadline_s: Optional[float] = None):
         self.seed = int(seed)
         self.psi = float(psi)
         self.label = label
         self.t_submit = time.perf_counter()
         self.t_done: Optional[float] = None
+        self.deadline = (None if deadline_s is None
+                         else self.t_submit + float(deadline_s))
         self._event = threading.Event()
         self._image: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
+        self._state = "pending"
+        self._lock = threading.Lock()
 
-    def _fulfill(self, image: np.ndarray) -> None:
-        self._image = image
-        self.t_done = time.perf_counter()
-        telemetry.histogram("serve/e2e_ms").observe(
-            (self.t_done - self.t_submit) * 1000.0)
-        self._event.set()
+    @property
+    def state(self) -> str:
+        return self._state
 
-    def _fail(self, err: BaseException) -> None:
-        self._error = err
-        self.t_done = time.perf_counter()
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) > self.deadline
+
+    def _resolve(self, state: str, image=None, error=None) -> bool:
+        with self._lock:
+            if self._state != "pending":
+                return False
+            self._state = state
+            self._image, self._error = image, error
+            self.t_done = time.perf_counter()
+        if state == "done":
+            telemetry.histogram("serve/e2e_ms").observe(
+                (self.t_done - self.t_submit) * 1000.0)
         self._event.set()
+        return True
+
+    def _fulfill(self, image: np.ndarray) -> bool:
+        return self._resolve("done", image=image)
+
+    def _fail(self, err: BaseException) -> bool:
+        return self._resolve("failed", error=err)
+
+    def cancel(self) -> bool:
+        """Abandon the request: a cancelled ticket is skipped at pop
+        time, so the dispatcher never computes work nobody will read.
+        Returns False when the ticket already reached a terminal
+        state."""
+        return self._resolve(
+            "cancelled",
+            error=Cancelled(f"request (seed={self.seed}) cancelled"))
 
     @property
     def latency_ms(self) -> Optional[float]:
@@ -81,20 +193,44 @@ class Ticket:
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self._event.wait(timeout):
-            raise TimeoutError(
-                f"request (seed={self.seed}) not served in {timeout}s")
+            # Orphaned-work fix: the client is giving up NOW — mark the
+            # ticket cancelled so the dispatcher skips it instead of
+            # synthesizing an image nobody will fetch.  A cancel that
+            # LOSES the race (the ticket reached a terminal state in
+            # the window after the wait deadline) delivers the real
+            # outcome below instead of a spurious TimeoutError.
+            if self.cancel():
+                raise TimeoutError(
+                    f"request (seed={self.seed}) not served in "
+                    f"{timeout}s")
+            self._event.wait(1.0)   # _resolve sets the event imminently
         if self._error is not None:
+            if isinstance(self._error, ServeError):
+                raise self._error
             raise RuntimeError("generation request failed") from self._error
         return self._image
 
 
 class GenerationService:
-    """Front a ``ServePrograms`` with a continuous-batching queue."""
+    """Front a ``ServePrograms`` with a continuous-batching queue under
+    the ISSUE 13 robustness floor (bounded admission, deadlines,
+    supervised dispatch, health states, graceful drain)."""
 
     def __init__(self, programs: ServePrograms,
                  max_fill_wait_ms: float = 2.0,
                  wcache_capacity: int = 4096,
-                 noise_seed: int = 0):
+                 noise_seed: int = 0,
+                 max_queue_depth: int = 256,
+                 default_deadline_s: Optional[float] = None,
+                 max_dispatcher_restarts: int = 3,
+                 restart_backoff_base_s: float = 0.05,
+                 restart_backoff_max_s: float = 2.0,
+                 hang_after_s: Optional[float] = 300.0,
+                 hang_startup_grace_s: float = 1800.0,
+                 quarantine_after: int = 2):
+        if max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth must be >= 1, got "
+                             f"{max_queue_depth}")
         self.programs = programs
         self._max_bucket = programs.buckets[-1]
         self._fill_wait_s = max(0.0, max_fill_wait_ms) / 1000.0
@@ -104,41 +240,254 @@ class GenerationService:
         self._pending: "deque[Ticket]" = deque()
         self._cv = threading.Condition()
         self._stop = False
-        # materialize every SLO family up front so an idle (or
-        # all-hit / all-miss) service still exports explicit zeros —
-        # the serve-family schema lint reads absence as rotted wiring
+        self._drain_failed = False
+        self._tripped = False
+        self._trip_cause: Optional[BaseException] = None
+        self._max_queue_depth = int(max_queue_depth)
+        self._default_deadline_s = default_deadline_s
+        self._max_restarts = int(max_dispatcher_restarts)
+        self._backoff_base_s = float(restart_backoff_base_s)
+        self._backoff_max_s = float(restart_backoff_max_s)
+        self._hang_after_s = hang_after_s
+        self._hang_startup_grace_s = float(hang_startup_grace_s)
+        self._quarantine_after = int(quarantine_after)
+        self._quarantined: Set[int] = set()
+        self._bucket_fails: Dict[int, int] = {}
+        self._restarts = 0
+        self._deaths_in_row = 0
+        self._fulfilled = 0
+        self._fulfilled_at_restart = 0
+        self._inflight: List[Ticket] = []
+        self._busy_since: Optional[float] = None
+        self._busy_cold = False     # current batch pays a lazy compile
+        self._poll_s = 0.05
+        # Dispatcher generation: bumped (under _cv) on every restart /
+        # breaker trip, so an ABANDONED-as-hung worker that later wakes
+        # retires at its next pop instead of racing the replacement.
+        self._gen = 0
+        # materialize every SLO + robustness family up front so an idle
+        # (or all-hit / all-miss / never-overloaded) service still
+        # exports explicit zeros — the serve-family schema lint reads
+        # absence as rotted wiring
         for name in ("serve/queue_depth", "serve/batch_fill",
                      "serve/e2e_ms", "serve/batch_ms"):
             telemetry.histogram(name)
-        for name in ("serve/requests_total", "serve/images_total"):
+        for name in ("serve/requests_total", "serve/images_total",
+                     "serve/shed_total", "serve/expired_total",
+                     "serve/cancelled_total",
+                     "serve/dispatcher_restarts_total",
+                     "serve/bucket_quarantined_total"):
             telemetry.counter(name)
+        telemetry.gauge("serve/queue_bound").set(self._max_queue_depth)
+        telemetry.gauge("serve/health_state").set(HEALTH_READY)
+        telemetry.gauge("serve/queue_depth_now").set(0)
         self._worker = LoopWorker(self._serve_dispatch,
                                   "serve/dispatch").start()
+        telemetry.gauge("serve/dispatcher_alive").set(1)
+        self._monitor = threading.Thread(target=self._supervise_dispatch,
+                                         name="serve-supervisor",
+                                         daemon=True)
+        self._monitor.start()
 
     # -- producer side -------------------------------------------------------
 
-    def submit(self, seed: int, psi: float = 0.7, label=None) -> Ticket:
-        self._worker.poll()            # surface a dead dispatcher HERE
-        t = Ticket(seed, psi, label)
+    def submit(self, seed: int, psi: float = 0.7, label=None,
+               deadline_s: Optional[float] = None) -> Ticket:
+        """Admit one request.  Typed refusals: ``ServiceClosed`` when
+        closed/draining, ``ServiceUnhealthy`` when the breaker is open,
+        ``Overloaded`` (counted in ``serve/shed_total``) when the
+        admission queue is at its bound."""
+        t = Ticket(seed, psi, label,
+                   deadline_s if deadline_s is not None
+                   else self._default_deadline_s)
+        shed = False
+        dropped: List[Ticket] = []
         with self._cv:
             if self._stop:
-                raise RuntimeError("service is closed")
-            self._pending.append(t)
-            telemetry.gauge("serve/queue_depth_now").set(len(self._pending))
-            self._cv.notify()
+                raise ServiceClosed("service is closed")
+            if self._tripped:
+                raise ServiceUnhealthy(
+                    f"circuit breaker open after {self._restarts} "
+                    f"dispatcher restart(s): "
+                    f"{self._trip_cause}") from self._trip_cause
+            if len(self._pending) >= self._max_queue_depth:
+                # compact DEAD tickets (cancelled / already expired)
+                # before shedding: slots held by abandoned work — e.g.
+                # clients that timed out against a wedged dispatcher —
+                # must not shed live traffic as phantom load
+                now = time.perf_counter()
+                keep: "deque[Ticket]" = deque()
+                for t2 in self._pending:
+                    if t2.state == "cancelled" or t2.expired(now):
+                        dropped.append(t2)
+                    else:
+                        keep.append(t2)
+                self._pending = keep
+            if len(self._pending) >= self._max_queue_depth:
+                shed = True
+            else:
+                self._pending.append(t)
+                telemetry.gauge("serve/queue_depth_now").set(
+                    len(self._pending))
+                self._cv.notify()
+        self._settle_dropped(dropped)
+        if shed:
+            telemetry.counter("serve/shed_total").inc()
+            raise Overloaded(
+                f"admission queue at its bound "
+                f"({self._max_queue_depth}) — request shed")
         telemetry.counter("serve/requests_total").inc()
         return t
 
+    def _settle_dropped(self, dropped: List[Ticket]) -> None:
+        """Resolve+count tickets discarded BEFORE dispatch (queue
+        compaction at submit, or the pop-time skip) — cancelled ones
+        are already resolved, expired ones fail typed here."""
+        for t in dropped:
+            if t.state == "cancelled":
+                telemetry.counter("serve/cancelled_total").inc()
+            else:
+                telemetry.counter("serve/expired_total").inc()
+                t._fail(Expired(
+                    f"request (seed={t.seed}) deadline passed "
+                    f"before dispatch"))
+
+    def health(self) -> dict:
+        """Point-in-time health snapshot: ``ready`` / ``degraded`` /
+        ``unhealthy`` / ``closed`` (clean shutdown) with reasons, also
+        exported as the ``serve/health_state`` gauge (0/1/2/3)."""
+        with self._cv:
+            depth = len(self._pending)
+            stop, tripped = self._stop, self._tripped
+            restarts = self._restarts
+            quarantined = sorted(self._quarantined)
+        alive = self._worker.alive
+        reasons: List[str] = []
+        if tripped:
+            state = HEALTH_UNHEALTHY
+            reasons.append(f"circuit breaker open after {restarts} "
+                           f"dispatcher restart(s)")
+        elif stop and self._drain_failed:
+            state = HEALTH_UNHEALTHY
+            reasons.append("drain failed: tickets were still "
+                           "queued/in-flight past the grace window")
+        elif stop:
+            # a CLEAN close is not a failure — the exported gauge must
+            # not read as a tripped breaker to the doctor/healthcheck
+            state = HEALTH_CLOSED
+            reasons.append("service closed/draining")
+        else:
+            state = HEALTH_READY
+            if depth >= self._max_queue_depth:
+                reasons.append(f"admission queue saturated "
+                               f"({depth}/{self._max_queue_depth})")
+            if restarts > 0:
+                reasons.append(f"dispatcher restarted {restarts} time(s) "
+                               f"(budget {self._max_restarts})")
+            if not alive:
+                reasons.append("dispatcher down (restart pending)")
+            if quarantined:
+                reasons.append(f"bucket(s) {quarantined} quarantined")
+            # per-instance counts (ServePrograms tracks its own): the
+            # process-global counters span every service ever run here
+            stale = self.programs.manifest_stale
+            hits = self.programs.warm_hits
+            if stale + hits > 0 and stale / (stale + hits) > 0.5:
+                reasons.append(
+                    f"warm-start fallback rate "
+                    f"{stale / (stale + hits):.0%} — the manifest is "
+                    f"mostly stale (recompiling at serve time)")
+            if reasons:
+                state = HEALTH_DEGRADED
+        telemetry.gauge("serve/health_state").set(state)
+        telemetry.gauge("serve/queue_depth_now").set(depth)
+        return {"state": _HEALTH_NAMES[state], "state_code": state,
+                "reasons": reasons, "queue_depth": depth,
+                "queue_bound": self._max_queue_depth,
+                "dispatcher_alive": alive,
+                "dispatcher_restarts": restarts,
+                "quarantined_buckets": quarantined,
+                "shed_total": telemetry.counter("serve/shed_total").value,
+                "expired_total":
+                    telemetry.counter("serve/expired_total").value,
+                "cancelled_total":
+                    telemetry.counter("serve/cancelled_total").value}
+
+    def install_signal_drain(self, grace_s: float = 30.0) -> bool:
+        """SIGTERM → graceful drain (main thread only; returns whether
+        the handler was installed).  The handler mirrors the training
+        loop's preemption discipline: stop admitting, serve the queue
+        within the grace window, fail the rest."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _on_term(signum, frame):
+            # Drain on a SEPARATE thread: the handler runs on the main
+            # thread at an arbitrary bytecode boundary, possibly while
+            # that thread already holds _cv (mid-submit) — close()
+            # inline would deadlock on the non-reentrant lock.  The
+            # drain thread just blocks until the interrupted frame
+            # releases it.
+            threading.Thread(target=self.close,
+                             kwargs={"timeout": grace_s},
+                             name="serve-sigterm-drain",
+                             daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            return False
+        return True
+
     def close(self, timeout: float = 30.0) -> None:
+        """Graceful drain: stop admitting, serve what's queued within
+        the grace window, then fail every leftover (queued or in-flight)
+        with a typed ``ServiceClosed`` — the finally-path guarantees no
+        ticket is left blocked even when the dispatcher died between
+        submit and close."""
         with self._cv:
             self._stop = True
             self._cv.notify_all()
-        self._worker.join(timeout)
-        with self._cv:
-            leftovers = list(self._pending)
-            self._pending.clear()
-        for t in leftovers:
-            t._fail(RuntimeError("service closed with request queued"))
+        try:
+            deadline = time.monotonic() + max(0.0, timeout)
+            self._monitor.join(timeout)
+            self._worker.join(max(0.0, deadline - time.monotonic()))
+        finally:
+            with self._cv:
+                leftovers = list(self._pending)
+                self._pending.clear()
+                telemetry.gauge("serve/queue_depth_now").set(0)
+            # dead tickets swept at drain still count as dropped-before-
+            # dispatch (and expired ones resolve with the typed Expired),
+            # exactly as a pop would have counted them
+            now = time.perf_counter()
+            dead = [t for t in leftovers
+                    if t.state == "cancelled" or t.expired(now)]
+            self._settle_dropped(dead)
+            failed = 0
+            for t in leftovers:
+                failed += t._fail(ServiceClosed(
+                    "service closed with request queued"))
+            if self._worker.alive:
+                # the dispatcher is wedged past the grace window: its
+                # batch is being failed below, so supersede its
+                # generation — when it finally unblocks it must not
+                # count images nobody received
+                with self._cv:
+                    self._gen += 1
+                    self._cv.notify_all()
+            failed += self._fail_inflight(ServiceClosed(
+                "service closed mid-batch (dispatcher did not drain "
+                "within the grace window)"))
+            telemetry.gauge("serve/dispatcher_alive").set(
+                1.0 if self._worker.alive else 0.0)
+            if failed:
+                self._drain_failed = True
+                telemetry.gauge("serve/health_state").set(HEALTH_UNHEALTHY)
+            elif not self._tripped:
+                # a clean drain exports as closed (3) even when the
+                # caller never polls health() again
+                telemetry.gauge("serve/health_state").set(HEALTH_CLOSED)
 
     def __enter__(self) -> "GenerationService":
         return self
@@ -146,32 +495,206 @@ class GenerationService:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- dispatcher supervision (monitor thread) -----------------------------
+
+    def _fail_inflight(self, err: BaseException) -> int:
+        """Resolve whatever the dispatcher had popped but not fulfilled
+        (idempotent — tickets already resolved are untouched)."""
+        with self._cv:
+            batch = list(self._inflight)
+            self._inflight = []
+        failed = 0
+        for t in batch:
+            if t._fail(err):
+                failed += 1
+            elif t.state == "cancelled":
+                # cancelled while in flight, batch never fulfilled:
+                # the cancel still counts (pop-time never saw it)
+                telemetry.counter("serve/cancelled_total").inc()
+        return failed
+
+    def _trip_breaker(self, cause: BaseException) -> None:
+        with self._cv:
+            self._tripped = True
+            self._trip_cause = cause
+            self._gen += 1
+            leftovers = list(self._pending)
+            self._pending.clear()
+            telemetry.gauge("serve/queue_depth_now").set(0)
+            self._cv.notify_all()
+        now = time.perf_counter()
+        self._settle_dropped([t for t in leftovers
+                              if t.state == "cancelled"
+                              or t.expired(now)])
+        for t in leftovers:
+            t._fail(ServiceUnhealthy(
+                f"circuit breaker open after {self._restarts} dispatcher "
+                f"restart(s): {cause}"))
+        telemetry.gauge("serve/health_state").set(HEALTH_UNHEALTHY)
+        telemetry.gauge("serve/dispatcher_alive").set(0)
+
+    def _supervise_dispatch(self) -> None:
+        """The serving twin of ``supervise/supervisor.py``: wait for the
+        dispatcher to die (crash, or hang past ``hang_after_s`` on one
+        batch), fail its in-flight tickets, and restart it under
+        progress-reset bounded backoff; exhaustion trips the circuit
+        breaker."""
+        while True:
+            worker = self._worker
+            hung = False
+            while True:
+                worker.join(self._poll_s)
+                if not worker.alive:
+                    break
+                busy = self._busy_since
+                # lazy per-bucket compiles may legitimately hold one
+                # batch for minutes (the supervisor.py startup-grace
+                # shape) — judging them with the steady-state budget
+                # would abandon a healthy dispatcher mid-compile and
+                # walk the breaker.  Graced: the window before the
+                # first fulfilled batch, and any batch whose bucket
+                # executable is not materialized yet.
+                cold = self._fulfilled == 0 or self._busy_cold
+                budget = (max(self._hang_after_s or 0.0,
+                              self._hang_startup_grace_s)
+                          if cold else self._hang_after_s)
+                if self._hang_after_s is not None and busy is not None \
+                        and time.monotonic() - busy > budget:
+                    hung = True
+                    break
+            if hung:
+                err: BaseException = ServiceUnhealthy(
+                    f"dispatcher hung: one batch busy for more than "
+                    f"{self._hang_after_s:.0f}s — abandoning the thread")
+            else:
+                err = worker.error
+                if err is None:
+                    return           # clean exit: stop-drain completed
+            with self._cv:
+                # supersede the dead/hung generation BEFORE failing its
+                # batch: a falsely-abandoned worker that wakes up later
+                # retires at its next pop instead of double-dispatching
+                self._gen += 1
+                self._cv.notify_all()
+            self._fail_inflight(err)
+            self._busy_since = None
+            self._busy_cold = False
+            telemetry.gauge("serve/dispatcher_alive").set(0)
+            # Progress resets the escalation (the supervisor.py shape):
+            # a dispatcher that served batches between deaths restarts
+            # eagerly forever; only BACK-TO-BACK no-progress deaths
+            # count against the budget and escalate the backoff.  Every
+            # death counts itself, so a zero budget means "never
+            # restart".  Progress = FULFILLED batches — counting popped
+            # batches would let a permanently-broken device reset the
+            # breaker by crashing one dispatch attempt at a time.
+            progress = self._fulfilled > self._fulfilled_at_restart
+            self._deaths_in_row = 1 if progress \
+                else self._deaths_in_row + 1
+            self._fulfilled_at_restart = self._fulfilled
+            if self._deaths_in_row > self._max_restarts:
+                self._trip_breaker(err)
+                return
+            delay = min(self._backoff_max_s,
+                        self._backoff_base_s
+                        * (2 ** (self._deaths_in_row - 1)))
+            deadline = time.monotonic() + delay
+            while time.monotonic() < deadline:
+                with self._cv:
+                    if self._stop and not self._pending:
+                        return   # nothing left to drain: stay down
+                time.sleep(min(self._poll_s,
+                               max(0.0, deadline - time.monotonic())))
+            # counted HERE, after the trip check AND the stay-down
+            # exit: a restart is a REPLACEMENT WORKER, nothing less
+            self._restarts += 1
+            telemetry.counter("serve/dispatcher_restarts_total").inc()
+            self._worker = LoopWorker(self._serve_dispatch,
+                                      "serve/dispatch").start()
+            telemetry.gauge("serve/dispatcher_alive").set(1)
+            telemetry.gauge("serve/health_state").set(HEALTH_DEGRADED)
+
     # -- consumer side (dispatcher thread) -----------------------------------
 
-    def _pop_batch(self) -> Optional[List[Ticket]]:
-        """Up to max-bucket queued tickets; None on shutdown.  After the
-        first arrival, waits at most ``max_fill_wait_ms`` for the batch
-        to fill — continuous batching, not fixed-size batching."""
+    def _select_bucket(self, n: int) -> int:
+        """Smallest NON-QUARANTINED bucket ≥ n; the largest bucket is
+        the route of last resort (never effectively quarantined)."""
+        for b in self.programs.buckets:
+            if b >= n and b not in self._quarantined:
+                return b
+        return self._max_bucket
+
+    def _note_bucket_failure(self, bucket: int) -> None:
+        # mutations under _cv: health() snapshots these sets from other
+        # threads, and an unlocked add() mid-sorted() would crash the
+        # liveness probe
         with self._cv:
-            while not self._pending and not self._stop:
-                self._cv.wait(0.25)
-            if not self._pending:
-                return None            # stopped and drained
-            if self._fill_wait_s > 0 and \
-                    len(self._pending) < self._max_bucket:
-                deadline = time.monotonic() + self._fill_wait_s
-                while len(self._pending) < self._max_bucket and \
-                        not self._stop:
-                    left = deadline - time.monotonic()
-                    if left <= 0:
-                        break
-                    self._cv.wait(left)
-            depth = len(self._pending)
-            take = min(depth, self._max_bucket)
-            batch = [self._pending.popleft() for _ in range(take)]
-            telemetry.histogram("serve/queue_depth").observe(depth)
-            telemetry.gauge("serve/queue_depth_now").set(len(self._pending))
-        return batch
+            fails = self._bucket_fails.get(bucket, 0) + 1
+            self._bucket_fails[bucket] = fails
+            quarantine = (fails >= self._quarantine_after
+                          and bucket != self._max_bucket
+                          and bucket not in self._quarantined)
+            if quarantine:
+                self._quarantined.add(bucket)
+        if quarantine:
+            telemetry.counter("serve/bucket_quarantined_total").inc()
+
+    def _pop_batch(self, gen: int) -> Optional[List[Ticket]]:
+        """Up to max-bucket ADMISSIBLE queued tickets; None on shutdown
+        or when this dispatcher generation was superseded.  Cancelled
+        tickets are skipped (``serve/cancelled_total``) and expired
+        ones resolved with ``Expired`` (``serve/expired_total``) HERE —
+        before dispatch, so dead work is never padded into a bucket.
+        After the first arrival, waits at most ``max_fill_wait_ms`` for
+        the batch to fill — continuous batching, not fixed-size
+        batching."""
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop and \
+                        gen == self._gen:
+                    self._cv.wait(0.25)
+                if gen != self._gen:
+                    return None            # superseded after a hang
+                if not self._pending:
+                    return None            # stopped and drained
+                if self._fill_wait_s > 0 and \
+                        len(self._pending) < self._max_bucket:
+                    deadline = time.monotonic() + self._fill_wait_s
+                    while len(self._pending) < self._max_bucket and \
+                            not self._stop and gen == self._gen:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._cv.wait(left)
+                    if gen != self._gen:
+                        return None
+                depth = len(self._pending)
+                batch: List[Ticket] = []
+                dropped: List[Ticket] = []
+                now = time.perf_counter()
+                while self._pending and len(batch) < self._max_bucket:
+                    t = self._pending.popleft()
+                    if t.state == "cancelled" or t.expired(now):
+                        dropped.append(t)
+                    else:
+                        batch.append(t)
+                telemetry.histogram("serve/queue_depth").observe(depth)
+                telemetry.gauge("serve/queue_depth_now").set(
+                    len(self._pending))
+                if batch:
+                    self._inflight = list(batch)
+                    self._busy_since = time.monotonic()
+            self._settle_dropped(dropped)
+            if batch:
+                return batch
+            # everything popped was dead — go back to waiting
+
+    def _finish_batch(self, gen: int) -> None:
+        with self._cv:
+            if gen == self._gen:
+                self._inflight = []
+                self._busy_since = None
+                self._busy_cold = False
 
     def _serve_dispatch(self) -> None:
         """The dispatch hot loop (hot-loop-sync discipline: device
@@ -179,17 +702,24 @@ class GenerationService:
         import jax
 
         programs, cache = self.programs, self.wcache
-        buckets = programs.buckets
+        gen = self._gen
         label_dim = programs.bundle.cfg.model.label_dim
         while True:
-            batch = self._pop_batch()
+            batch = self._pop_batch(gen)
             if batch is None:
                 return
             self._worker.beat()
             t0 = time.perf_counter()
+            # the bucket whose executable is CURRENTLY dispatching —
+            # failure attribution for quarantine (map_misses points it
+            # at the mapping bucket while that program runs)
+            fail_bucket = None
             try:
                 n = len(batch)
-                bucket = bucket_for(n, buckets)
+                self._batches += 1
+                faults.fire("serve_dispatch", batch=self._batches, n=n)
+                bucket = self._select_bucket(n)
+                fail_bucket = bucket
                 telemetry.histogram("serve/batch_fill").observe(n / bucket)
                 rows: List[Optional[np.ndarray]] = [None] * n
                 miss: List[int] = []
@@ -199,14 +729,23 @@ class GenerationService:
                         miss.append(i)
                     else:
                         rows[i] = row
+                # a batch that will pay a lazy cold compile gets the
+                # hang watchdog's startup grace, not the steady budget
+                self._busy_cold = (
+                    not programs.is_compiled("synthesize", bucket)
+                    or bool(miss) and not programs.is_compiled(
+                        "map_seeds", self._select_bucket(len(miss))))
                 psi = np.full((bucket,), 1.0, np.float32)
                 psi[:n] = [t.psi for t in batch]
-                self._batches += 1
                 noise = np.array([self._noise_seed, self._batches],
                                  np.uint32)
 
                 def map_misses():
-                    mb = bucket_for(len(miss), buckets)
+                    nonlocal fail_bucket
+                    faults.fire("serve_map", batch=self._batches,
+                                n=len(miss))
+                    mb = self._select_bucket(len(miss))
+                    fail_bucket = mb
                     seeds = np.full((mb,), batch[miss[-1]].seed, np.int32)
                     seeds[:len(miss)] = [batch[i].seed for i in miss]
                     mlabel = None
@@ -214,7 +753,9 @@ class GenerationService:
                         mlabel = np.zeros((mb, label_dim), np.float32)
                         for j, i in enumerate(miss):
                             mlabel[j] = batch[i].label
-                    return programs.map_seeds(seeds, mlabel)
+                    out = programs.map_seeds(seeds, mlabel)
+                    fail_bucket = bucket   # mapping dispatched fine
+                    return out
 
                 def cache_fill(ws_host):
                     for j, i in enumerate(miss):
@@ -232,6 +773,7 @@ class GenerationService:
                     ws_dev = map_misses()
                     imgs_dev = programs.synthesize(ws_dev, psi, noise)
                     with span("serve_fetch"):
+                        faults.fire("serve_fetch", batch=self._batches)
                         cache_fill(np.asarray(jax.device_get(ws_dev)))
                 else:
                     if miss:
@@ -247,13 +789,47 @@ class GenerationService:
                     ws = np.stack(rows + [rows[-1]] * (bucket - n))
                     imgs_dev = programs.synthesize(ws, psi, noise)
                 with span("serve_fetch"):
+                    faults.fire("serve_fetch", batch=self._batches)
                     imgs = np.asarray(jax.device_get(imgs_dev))
+                if gen != self._gen:
+                    # superseded mid-batch (hang verdict): the
+                    # supervisor already failed these tickets — don't
+                    # count images nobody received
+                    return
+                faults.fire("serve_fulfill", batch=self._batches, n=n)
+                delivered = 0
                 for i, t in enumerate(batch):
-                    t._fulfill(imgs[i])
-                telemetry.counter("serve/images_total").inc(n)
+                    if t._fulfill(imgs[i]):
+                        delivered += 1
+                    elif t.state == "cancelled":
+                        # cancelled while in flight: computed but not
+                        # delivered — count the cancel, not an image
+                        telemetry.counter("serve/cancelled_total").inc()
+                self._fulfilled += 1
+                with self._cv:
+                    # this batch proved both executables it used —
+                    # reset their consecutive-failure counts
+                    self._bucket_fails.pop(bucket, None)
+                    if miss:
+                        self._bucket_fails.pop(
+                            self._select_bucket(len(miss)), None)
+                telemetry.counter("serve/images_total").inc(delivered)
                 telemetry.histogram("serve/batch_ms").observe(
                     (time.perf_counter() - t0) * 1000.0)
+                self._finish_batch(gen)
             except BaseException as e:
+                # Attribution is exact for executables that raise at
+                # call time (the observed poisoned-program mode); an
+                # async device error surfacing at the later fetch is
+                # charged to the synthesis bucket.  A SUPERSEDED worker
+                # (abandoned as hung, then woke into an error) charges
+                # nothing — its verdict belongs to a dead generation.
+                if fail_bucket is not None and gen == self._gen:
+                    self._note_bucket_failure(fail_bucket)
                 for t in batch:
-                    t._fail(e)
-                raise   # sticky on the LoopWorker; submitters see poll()
+                    if not t._fail(e) and t.state == "cancelled":
+                        # as in _fail_inflight: an in-flight cancel on
+                        # a failed batch still counts
+                        telemetry.counter("serve/cancelled_total").inc()
+                self._finish_batch(gen)
+                raise   # LoopWorker stores it; the supervisor restarts

@@ -33,8 +33,13 @@ Actions:
 
 Fire points wired today: ``ckpt_mid_write`` / ``ckpt_after_write``
 (train/checkpoint.py, step=), ``tick`` (train/loop.py, tick=/step=),
-``data_thread`` (data/dataset.py prefetch producer, batch=).  A point
-with no armed spec costs one tuple-check per call.
+``data_thread`` (data/dataset.py prefetch producer, batch=), and the
+SERVING path (serve/service.py, ISSUE 13; coords: monotonic ``batch``
+plus ``n``): ``serve_dispatch`` (top of each dispatch iteration),
+``serve_map`` (before the mapping dispatch), ``serve_fetch`` (inside
+the sanctioned fetch span), ``serve_fulfill`` (before tickets resolve)
+— ``raise`` exercises dispatcher restart/breaker, ``hang`` the hang
+watchdog.  A point with no armed spec costs one tuple-check per call.
 """
 
 from __future__ import annotations

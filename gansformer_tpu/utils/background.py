@@ -40,16 +40,17 @@ class LoopWorker:
     """A long-lived background loop thread with the writer discipline.
 
     ``SingleSlotWriter`` owns one-shot jobs; this owns a CONTINUOUS
-    loop (the serving dispatch loop, ISSUE 10) under the same failure
-    contract: the target runs once on its own thread (the target body
-    is the ``while``), an escaped exception is stored STICKY and
-    re-raised — wrapped in ``BackgroundWriteError`` — at EVERY later
-    ``poll()``, so every producer thread (request submitter) surfaces a
-    dead dispatcher within one call instead of blocking on tickets that
-    will never resolve.  Unlike ``SingleSlotWriter`` (one-shot jobs,
-    error delivered once then cleared), a dead continuous loop never
-    becomes healthy again — clearing on first delivery would let every
-    later submitter enqueue into a dead service.  Telemetry, per ``prefix``:
+    loop (the serving dispatch loop, ISSUEs 10 + 13) under the same
+    failure contract: the target runs once on its own thread (the
+    target body is the ``while``), an escaped exception is stored
+    STICKY — readable un-wrapped via ``error`` (how the serving
+    supervisor classifies a death before restarting a replacement
+    worker, serve/service.py) or re-raised wrapped in
+    ``BackgroundWriteError`` at EVERY later ``poll()``.  Unlike
+    ``SingleSlotWriter`` (one-shot jobs, error delivered once then
+    cleared), a dead continuous loop never becomes healthy again —
+    one ``LoopWorker`` is one dispatcher lifetime; recovery means a
+    NEW worker, never a cleared error.  Telemetry, per ``prefix``:
     ``<prefix>_heartbeat`` gauge (last liveness touch — call
     ``beat()`` from inside the loop), ``<prefix>_errors_total``.
     """
@@ -109,6 +110,14 @@ class LoopWorker:
     @property
     def alive(self) -> bool:
         return self._thread.is_alive()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The stored loop crash, un-raised and un-wrapped — for a
+        SUPERVISOR deciding restart-vs-trip (serve/service.py), where
+        ``poll()``'s raise-on-read contract is the wrong shape."""
+        with self._lock:
+            return self._error
 
     def _run(self, target: Callable[[], None]) -> None:
         try:

@@ -139,6 +139,32 @@ def default_stages():
                "--duration-s", "600",
                "--manifest-dir", ".serve_manifest",
                "--json-out", "{win}/serve_loadtest.json"]),
+        # 6c. Serving overload/chaos drill (ISSUE 13): burst 4x the
+        #     admission bound back-to-back with one injected dispatcher
+        #     crash mid-burst — proves the degradation contract on real
+        #     hardware: typed shedding (not unbounded queueing), the
+        #     self-healing restart, p99-under-overload, recovery time,
+        #     and zero hung tickets.  Capture beats verdict: the stage
+        #     completes on the LOADTEST exit code (0 whenever
+        #     {win}/serve_chaos.json lands); the doctor then grades the
+        #     window — its serve_chaos section FAILs on hung tickets —
+        #     into {win}/serve_doctor.json without gating completion.
+        #     --prom-out keeps the chaos-state prom out of 6b's
+        #     {win}/telemetry.prom (the SLO run's artifact must survive
+        #     unclobbered).  The shared persistent manifest means the
+        #     flagship compiles were already paid by 6b.
+        stage("serve_chaos", 600, "serve_chaos_tpu.json",
+              ["sh", "-c",
+               f"{py} scripts/loadtest_serve.py --chaos"
+               f" --preset ffhq256-duplex --init random"
+               f" --buckets 1,4,8 --queue-depth 16"
+               f" --burst-factor 4 --crash-at-batch 2"
+               f" --manifest-dir .serve_manifest"
+               f" --json-out {{win}}/serve_chaos.json"
+               f" --prom-out {{win}}/serve_chaos.prom; rc=$?;"
+               f" {py} -m gansformer_tpu.cli.telemetry doctor {{win}}/"
+               f" --json-out {{win}}/serve_doctor.json"
+               f" >/dev/null 2>&1; exit $rc"]),
         # 7. Batch sweep (the optional throughput upside).
         stage("bench_sweep", 1800, "bench_sweep_tpu.json", [py, "bench.py"],
               env={"GRAFT_BENCH_TPU_TIMEOUT": "1500",

@@ -13,10 +13,19 @@ whenever the JSON artifact is written — SLO verdicts live IN the
 artifact (``prom_ok``, the latency table), never in the exit code, so a
 slow window still banks its numbers.
 
+``--chaos`` (ISSUE 13) is the overload/failure variant: a back-to-back
+burst of ``--burst-factor`` × ``--queue-depth`` requests against the
+bounded admission queue, with one injected dispatcher crash
+(``raise@serve_dispatch`` at ``--crash-at-batch``).  The artifact
+reports shed/expired rates, p50/p99 *under overload*, dispatcher
+restarts, recovery time, and the hung-ticket count (must be 0).
+
     python scripts/loadtest_serve.py --tiny --requests 64 --json-out out.json
     python scripts/loadtest_serve.py --preset ffhq256-duplex --init random \
         --buckets 1,4,8 --requests 300 --rate 8 --duration-s 300 \
         --json-out serve_loadtest.json
+    python scripts/loadtest_serve.py --tiny --chaos --queue-depth 8 \
+        --json-out serve_chaos.json
 """
 
 from __future__ import annotations
@@ -47,6 +56,166 @@ def zipf_choice(rng, universe, size, s: float):
 
     p = 1.0 / np.arange(1, len(universe) + 1, dtype=np.float64) ** s
     return rng.choice(universe, size=size, p=p / p.sum())
+
+
+def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
+              crash_at_batch=2, deadline_s=None, zipf_s=1.1,
+              seed_universe=64, manifest_dir=None, fill_wait_ms=0.0,
+              wcache=4096, seed=0, restart_backoff_s=0.05,
+              grace_s=60.0):
+    """Overload + chaos drill (ISSUE 13): submit ``burst_factor ×
+    queue_depth`` requests back-to-back (arrival far beyond capacity)
+    against a service with a bounded admission queue, with ONE injected
+    dispatcher crash mid-burst (``raise@serve_dispatch``).  Reports the
+    degradation report card: shed/expired/cancelled rates, p50/p99
+    *under overload* (served tickets only), dispatcher restarts,
+    recovery time (first successful completion after the first
+    failure), and the hung-ticket count — the acceptance number that
+    MUST be zero.  Pure of argparse/IO so tests call it directly."""
+    import jax
+    import numpy as np
+
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import (
+        Cancelled, Expired, GenerationService, Overloaded, ServeError,
+        ServePrograms)
+    from gansformer_tpu.supervise import faults
+
+    rng = np.random.RandomState(seed)
+    programs = ServePrograms(bundle, buckets=buckets,
+                             manifest_dir=manifest_dir)
+    warm = programs.warm_start()
+    n_req = int(burst_factor * queue_depth)
+    seeds = zipf_choice(rng, np.arange(1, seed_universe + 1), n_req,
+                        zipf_s)
+    reg = telemetry.get_registry()
+    restarts0 = reg.counter("serve/dispatcher_restarts_total").value
+    tickets, shed = [], 0
+    outcomes = {"served": 0, "failed": 0, "expired": 0, "cancelled": 0,
+                "hung": 0}
+
+    def settle(wave):
+        # ONE shared wall-clock budget per wave, not grace_s per ticket:
+        # a wedged dispatcher with N hung tickets must cost ~grace_s,
+        # not N x grace_s — the battery stage budget (and the artifact)
+        # depend on the drill bounding itself
+        deadline = time.perf_counter() + grace_s
+        for t in wave:
+            try:
+                t.result(timeout=max(0.1,
+                                     deadline - time.perf_counter()))
+                outcomes["served"] += 1
+            except Expired:
+                outcomes["expired"] += 1
+            except Cancelled:
+                outcomes["cancelled"] += 1
+            except TimeoutError:
+                outcomes["hung"] += 1      # the zero-tolerance bucket
+            except RuntimeError:
+                outcomes["failed"] += 1
+
+    t0 = time.perf_counter()
+    svc = None
+    try:
+        # arm INSIDE the disarming try: an exception anywhere past this
+        # point (service construction included) must not leak an armed
+        # process-global fault spec into later callers
+        if crash_at_batch:
+            faults.arm(faults.parse_specs(
+                f"raise@serve_dispatch:batch={int(crash_at_batch)}"))
+        svc = GenerationService(programs, max_fill_wait_ms=fill_wait_ms,
+                                wcache_capacity=wcache,
+                                max_queue_depth=queue_depth,
+                                default_deadline_s=deadline_s,
+                                restart_backoff_base_s=restart_backoff_s)
+        # Wave 1 — the overload burst: back-to-back submits far beyond
+        # capacity; over-bound submissions shed typed.  Capture beats
+        # verdict: a breaker tripped by real deaths on sick hardware
+        # refuses typed (ServiceUnhealthy) — counted, never raised out
+        # of the drill (the artifact must land EXACTLY then).
+        refused = 0
+        for i in range(n_req):
+            try:
+                tickets.append(svc.submit(int(seeds[i])))
+            except Overloaded:
+                shed += 1
+            except ServeError:
+                refused += 1
+        settle(tickets)
+        # Wave 2 — paced recovery traffic: guarantees the dispatcher
+        # sees MULTIPLE batches (a small burst can fit one bucket, in
+        # which case the injected crash would idle un-fired) and that
+        # post-crash service is measured, not assumed.
+        recovery_wave = []
+        n_wave2 = max(2, int(queue_depth))
+        for i in range(n_wave2):
+            try:
+                recovery_wave.append(
+                    svc.submit(int(seeds[i % n_req]) + seed_universe))
+            except Overloaded:
+                shed += 1
+            except ServeError:
+                refused += 1
+            time.sleep(0.002)
+        burst_tickets = list(tickets)
+        tickets += recovery_wave
+        settle(recovery_wave)
+        recovered = sum(1 for t in recovery_wave if t.state == "done")
+        health = svc.health()
+    finally:
+        if svc is not None:
+            svc.close(timeout=grace_s)
+        faults.disarm()
+    wall_s = time.perf_counter() - t0
+    # recovery: first successful completion AFTER the first failure
+    fails = [t.t_done for t in tickets
+             if t.state == "failed" and t.t_done is not None]
+    servs = sorted(t.t_done for t in tickets
+                   if t.state == "done" and t.t_done is not None)
+    recovery_ms = None
+    if fails:
+        after = [s for s in servs if s > min(fails)]
+        if after:
+            recovery_ms = round((after[0] - min(fails)) * 1000.0, 1)
+    # percentiles over the BURST wave only: blending in the paced
+    # recovery wave's healthy latencies would dilute "under overload";
+    # None (not NaN — invalid strict JSON) when nothing was served
+    lats = sorted(t.latency_ms for t in burst_tickets
+                  if t.state == "done")
+    return {
+        "mode": "chaos", "buckets": list(buckets),
+        "queue_bound": queue_depth, "burst_factor": burst_factor,
+        "crash_at_batch": crash_at_batch,
+        "deadline_s": deadline_s,
+        # submitted/shed/shed_rate span BOTH waves (burst + recovery),
+        # so accepted <= submitted and shed_rate <= 1.0 always hold
+        "submitted": n_req + n_wave2, "burst": n_req,
+        "accepted": len(tickets), "shed": shed,
+        "refused_unhealthy": refused,
+        "shed_rate": round(shed / max(n_req + n_wave2, 1), 4),
+        "recovery_wave_served": recovered,
+        "served": outcomes["served"], "failed": outcomes["failed"],
+        "expired": outcomes["expired"],
+        "expired_rate": round(outcomes["expired"]
+                              / max(n_req + n_wave2, 1), 4),
+        "cancelled": outcomes["cancelled"],
+        "hung_tickets": outcomes["hung"],
+        "p50_ms_under_overload":
+            round(percentile(lats, 50), 2) if lats else None,
+        "p99_ms_under_overload":
+            round(percentile(lats, 99), 2) if lats else None,
+        "dispatcher_restarts":
+            reg.counter("serve/dispatcher_restarts_total").value
+            - restarts0,
+        "recovery_ms": recovery_ms,
+        "health": health,
+        "warm_start": {k: (round(v, 3) if k == "seconds" else v)
+                       for k, v in warm.items()},
+        "duration_s": round(wall_s, 3),
+        "device": {"platform": jax.devices()[0].platform,
+                   "kind": jax.devices()[0].device_kind,
+                   "count": len(jax.devices())},
+    }
 
 
 def run_loadtest(bundle, buckets, requests, rate, duration_s,
@@ -112,8 +281,12 @@ def run_loadtest(bundle, buckets, requests, rate, duration_s,
 
     tickets = []
     t_start = time.perf_counter()
+    # the SLO loadtest measures latency under admission, not shedding:
+    # the bound sits above the whole request budget so nothing sheds
+    # (the overload/chaos mode is run_chaos)
     with GenerationService(programs, max_fill_wait_ms=fill_wait_ms,
-                           wcache_capacity=wcache) as svc:
+                           wcache_capacity=wcache,
+                           max_queue_depth=requests + 8) as svc:
         for i in range(requests):
             if time.perf_counter() - t_start > duration_s:
                 break
@@ -175,6 +348,22 @@ def main(argv=None) -> int:
     p.add_argument("--fill-wait-ms", type=float, default=2.0)
     p.add_argument("--wcache", type=int, default=4096)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--chaos", action="store_true",
+                   help="overload/chaos drill instead of the SLO "
+                        "loadtest: burst past the queue bound with one "
+                        "injected dispatcher crash; reports shed/expired "
+                        "rates, p99-under-overload, restarts, recovery "
+                        "time, hung tickets (must be 0)")
+    p.add_argument("--queue-depth", type=int, default=8,
+                   help="chaos: admission queue bound")
+    p.add_argument("--burst-factor", type=float, default=4.0,
+                   help="chaos: submit burst-factor x queue-depth "
+                        "requests back-to-back")
+    p.add_argument("--crash-at-batch", type=int, default=2,
+                   help="chaos: inject raise@serve_dispatch at this "
+                        "batch (0 = no crash, overload only)")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="chaos: per-request deadline")
     p.add_argument("--manifest-dir", default=None,
                    help="warm-start manifest dir ('' disables; default: a "
                         "fresh temp dir so cold-vs-warm is honest)")
@@ -219,13 +408,24 @@ def main(argv=None) -> int:
     else:
         manifest_dir = args.manifest_dir
 
-    result = run_loadtest(
-        bundle, tuple(int(b) for b in args.buckets.split(",")),
-        requests=args.requests, rate=args.rate,
-        duration_s=args.duration_s, zipf_s=args.zipf_s,
-        seed_universe=args.seed_universe, manifest_dir=manifest_dir,
-        fill_wait_ms=args.fill_wait_ms, wcache=args.wcache,
-        seed=args.seed)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    if args.chaos:
+        result = run_chaos(
+            bundle, buckets, queue_depth=args.queue_depth,
+            burst_factor=args.burst_factor,
+            crash_at_batch=args.crash_at_batch,
+            deadline_s=args.deadline_s, zipf_s=args.zipf_s,
+            seed_universe=args.seed_universe, manifest_dir=manifest_dir,
+            fill_wait_ms=args.fill_wait_ms, wcache=args.wcache,
+            seed=args.seed)
+    else:
+        result = run_loadtest(
+            bundle, buckets,
+            requests=args.requests, rate=args.rate,
+            duration_s=args.duration_s, zipf_s=args.zipf_s,
+            seed_universe=args.seed_universe, manifest_dir=manifest_dir,
+            fill_wait_ms=args.fill_wait_ms, wcache=args.wcache,
+            seed=args.seed)
 
     # telemetry.prom + the schema lint's serve-family check: the SLO
     # histograms must be PRESENT and well-formed, verdict in-artifact
@@ -238,7 +438,8 @@ def main(argv=None) -> int:
 
         telemetry.get_registry().write_prom(prom_path)
         errors = check_prom(prom_path) + \
-            check_serve_metric_families(prom_path)
+            check_serve_metric_families(prom_path,
+                                        expect_overload=args.chaos)
         result["prom"] = prom_path
         result["prom_ok"] = not errors
         result["prom_errors"] = errors

@@ -118,6 +118,33 @@ def test_serve_loadtest_stage_banks_slo_artifact():
     assert names.index("serve_loadtest") < names.index("bench_sweep")
 
 
+def test_serve_chaos_stage_banks_overload_artifact():
+    """ISSUE 13 satellite: the battery runs the overload/chaos drill —
+    burst past the admission bound with one injected dispatcher crash —
+    and archives {win}/serve_chaos.json (capture beats verdict: the
+    script exits 0 whenever the artifact lands; the doctor's serving
+    section grades hung tickets / recovery)."""
+    stages = {s["name"]: s for s in battery.default_stages()}
+    st = stages["serve_chaos"]
+    argv = " ".join(st["argv"])
+    assert "scripts/loadtest_serve.py" in argv and "--chaos" in argv
+    assert "--json-out {win}/serve_chaos.json" in argv
+    assert "--queue-depth" in argv and "--crash-at-batch" in argv
+    # rides the SAME persistent manifest as the SLO loadtest, so the
+    # flagship compiles are paid once across both stages
+    assert "--manifest-dir .serve_manifest" in argv
+    # the chaos prom must not clobber 6b's {win}/telemetry.prom
+    assert "--prom-out {win}/serve_chaos.prom" in argv
+    # doctor grades the window (serve_chaos section) without gating
+    # completion: the stage exit is the loadtest's rc
+    assert "telemetry doctor {win}/" in argv
+    assert "--json-out {win}/serve_doctor.json" in argv
+    assert "exit $rc" in argv
+    names = [s["name"] for s in battery.default_stages()]
+    assert names.index("serve_loadtest") < names.index("serve_chaos")
+    assert names.index("serve_chaos") < names.index("bench_sweep")
+
+
 def test_scaling_stage_runs_bench_scaling():
     """ISSUE 7: the battery measures scaling efficiency on real chips —
     bench.py --scaling before the optional sweep, stable artifact copy

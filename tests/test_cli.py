@@ -1,6 +1,8 @@
 """CLI surface tests — config construction only (no training)."""
 
 import json
+import os
+import time
 
 import numpy as np
 import pytest
@@ -335,6 +337,72 @@ def test_serve_cli_warm_start_zero_compiles(tmp_path, micro_run_dir,
     assert os.path.exists(os.path.join(out, "served_grid.png"))
     prom = os.path.join(out, "telemetry.prom")
     assert check_serve_metric_families(prom) == []
+
+
+def test_serve_cli_healthcheck_grades_prom(tmp_path, capsys):
+    """ISSUE 13: ``gansformer-serve --healthcheck`` grades an exported
+    telemetry.prom without touching the accelerator — exit 0 for
+    ready/degraded, 1 for unhealthy / dead-with-work / missing."""
+    from gansformer_tpu.cli.serve import main as serve
+
+    def write_prom(name, **vals):
+        path = str(tmp_path / name)
+        with open(path, "w") as f:
+            for k, v in vals.items():
+                f.write(f"# TYPE {k} gauge\n{k} {v}\n")
+        return path
+
+    ready = write_prom("ready.prom", serve_health_state=0,
+                       serve_dispatcher_alive=1, serve_queue_depth_now=2,
+                       serve_queue_bound=256, serve_shed_total=0)
+    assert serve(["--healthcheck", ready]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["state"] == "ready" and out["ok"]
+
+    tripped = write_prom("tripped.prom", serve_health_state=2,
+                         serve_dispatcher_alive=0,
+                         serve_queue_depth_now=0)
+    assert serve(["--healthcheck", tripped]) == 1
+    assert json.loads(capsys.readouterr().out)["state"] == "unhealthy"
+
+    # degraded but alive-with-empty-queue is still serviceable
+    degraded = write_prom("degraded.prom", serve_health_state=1,
+                          serve_dispatcher_alive=1,
+                          serve_queue_depth_now=1)
+    assert serve(["--healthcheck", degraded]) == 0
+    capsys.readouterr()
+
+    # a CLEANLY closed service's final prom is ok, not an alarm
+    closed = write_prom("closed.prom", serve_health_state=3,
+                        serve_dispatcher_alive=0,
+                        serve_queue_depth_now=0)
+    assert serve(["--healthcheck", closed]) == 0
+    assert json.loads(capsys.readouterr().out)["state"] == "closed"
+
+    # dead dispatcher with queued work: probes must flag it
+    dead = write_prom("dead.prom", serve_health_state=1,
+                      serve_dispatcher_alive=0, serve_queue_depth_now=3)
+    assert serve(["--healthcheck", dead]) == 1
+    capsys.readouterr()
+
+    assert serve(["--healthcheck", str(tmp_path / "absent.prom")]) == 1
+    # a non-serving prom (no health gauge) is unknown, not ready
+    blank = write_prom("blank.prom", device_sampler_off=1)
+    assert serve(["--healthcheck", blank]) == 1
+    capsys.readouterr()
+
+    # staleness: a frozen last-good snapshot must not pass a liveness
+    # probe forever — but stays gradeable without the age bound
+    old = time.time() - 3600
+    os.utime(ready, (old, old))
+    assert serve(["--healthcheck", ready]) == 0       # age reported only
+    assert json.loads(capsys.readouterr().out)["snapshot_age_s"] > 3000
+    assert serve(["--healthcheck", ready,
+                  "--health-max-age", "300"]) == 1
+    assert json.loads(capsys.readouterr().out)["state"] == "stale"
+    assert serve(["--healthcheck", ready,
+                  "--health-max-age", "7200"]) == 0
+    capsys.readouterr()
 
 
 def test_config_validate_messages():

@@ -192,6 +192,110 @@ def test_restart_count_from_resume_records(tmp_path):
     assert "step 1000" in detail(report, "restarts")
 
 
+def _serve_metrics(health=0.0, alive=1.0, depth=1.0, bound=256.0,
+                   requests=100.0, shed=0.0, restarts=0.0):
+    return ({"serve/health_state": health, "serve/dispatcher_alive": alive,
+             "serve/queue_depth_now": depth, "serve/queue_bound": bound},
+            {"serve/requests_total": requests, "serve/shed_total": shed,
+             "serve/dispatcher_restarts_total": restarts})
+
+
+def test_serving_section_absent_without_serve_telemetry(tmp_path):
+    report = run_doctor(synth_run_dir(tmp_path), now=NOW)
+    assert "serving" not in levels(report)
+
+
+def test_serving_section_goldens(tmp_path):
+    g, c = _serve_metrics()
+    ok = run_doctor(synth_run_dir(tmp_path, gauges=g, counters=c,
+                                  name="s_ok"), now=NOW)
+    assert levels(ok)["serving"] == "PASS"
+    assert "100 request(s)" in detail(ok, "serving")
+
+    g, c = _serve_metrics(health=2.0, alive=0.0)
+    tripped = run_doctor(synth_run_dir(tmp_path, gauges=g, counters=c,
+                                       name="s_trip"), now=NOW)
+    assert levels(tripped)["serving"] == "FAIL"
+    assert "UNHEALTHY" in detail(tripped, "serving")
+    assert not tripped["ok"]
+
+    g, c = _serve_metrics(health=1.0, alive=0.0, depth=3.0)
+    dead = run_doctor(synth_run_dir(tmp_path, gauges=g, counters=c,
+                                    name="s_dead"), now=NOW)
+    assert levels(dead)["serving"] == "FAIL"
+    assert "dispatcher dead" in detail(dead, "serving")
+
+    g, c = _serve_metrics(requests=95.0, shed=5.0)
+    shed = run_doctor(synth_run_dir(tmp_path, gauges=g, counters=c,
+                                    name="s_shed"), now=NOW)
+    assert levels(shed)["serving"] == "WARN"
+    assert "shed rate" in detail(shed, "serving")
+    assert shed["ok"]                      # WARN never fails the doctor
+
+    g, c = _serve_metrics(depth=256.0)
+    sat = run_doctor(synth_run_dir(tmp_path, gauges=g, counters=c,
+                                   name="s_sat"), now=NOW)
+    assert levels(sat)["serving"] == "WARN"
+    assert "saturated" in detail(sat, "serving")
+
+
+def test_serving_shed_warn_suppressed_by_chaos_artifact(tmp_path):
+    """A serve_chaos.json beside the telemetry declares the overload
+    was deliberately driven — the shed-rate WARN becomes a PASS with a
+    note instead of a scale-out false alarm."""
+    g, c = _serve_metrics(requests=30.0, shed=70.0, restarts=1.0)
+    d = synth_run_dir(tmp_path, gauges=g, counters=c, name="s_drill")
+    with open(os.path.join(d, "serve_chaos.json"), "w") as f:
+        json.dump({"shed_rate": 0.7, "expired_rate": 0.0,
+                   "p99_ms_under_overload": 42.0,
+                   "dispatcher_restarts": 1, "recovery_ms": 55.0,
+                   "crash_at_batch": 2, "hung_tickets": 0}, f)
+    report = run_doctor(d, now=NOW)
+    assert levels(report)["serving"] == "PASS"
+    assert "deliberately driven" in detail(report, "serving")
+    assert levels(report)["serve_chaos"] == "PASS"
+
+
+def test_serve_chaos_artifact_grading(tmp_path):
+    """serve_chaos.json beside the telemetry: hung tickets FAIL, a
+    never-fired injected crash WARNs, a clean drill PASSes with the
+    report-card numbers."""
+    g, c = _serve_metrics(restarts=1.0)
+    base = {"shed_rate": 0.6, "expired_rate": 0.0,
+            "p99_ms_under_overload": 42.0, "dispatcher_restarts": 1,
+            "recovery_ms": 55.0, "crash_at_batch": 2, "hung_tickets": 0}
+
+    def with_chaos(blob, name):
+        d = synth_run_dir(tmp_path, gauges=dict(g), counters=dict(c),
+                          name=name)
+        with open(os.path.join(d, "serve_chaos.json"), "w") as f:
+            json.dump(blob, f)
+        return d
+
+    ok = run_doctor(with_chaos(base, "c_ok"), now=NOW)
+    assert levels(ok)["serve_chaos"] == "PASS"
+    assert "recovery 55.0 ms" in detail(ok, "serve_chaos")
+
+    hung = run_doctor(with_chaos(dict(base, hung_tickets=2), "c_hung"),
+                      now=NOW)
+    assert levels(hung)["serve_chaos"] == "FAIL"
+    assert not hung["ok"]
+
+    dud = run_doctor(with_chaos(dict(base, dispatcher_restarts=0),
+                                "c_dud"), now=NOW)
+    assert levels(dud)["serve_chaos"] == "WARN"
+    assert "never fired" in detail(dud, "serve_chaos")
+
+    # the drill's own health snapshot (whose prom may live in a file
+    # the doctor never reads) grades: breaker tripped mid-drill = FAIL
+    sick = run_doctor(with_chaos(
+        dict(base, health={"state": "unhealthy",
+                           "reasons": ["circuit breaker open"]}),
+        "c_sick"), now=NOW)
+    assert levels(sick)["serve_chaos"] == "FAIL"
+    assert "UNHEALTHY" in detail(sick, "serve_chaos")
+
+
 def test_not_a_run_dir_fails(tmp_path):
     report = run_doctor(str(tmp_path), now=NOW)
     assert not report["ok"]

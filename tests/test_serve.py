@@ -15,13 +15,18 @@ The load-bearing contracts, each pinned here:
   and corrupt/stale manifest entries fall back to recompile;
 * a repeat-seed request never dispatches the mapping program
   (``serve/map_dispatch_total`` stays flat — the acceptance counter);
-* a dead dispatcher surfaces at ``submit()`` (LoopWorker discipline),
-  not as a hang.
+* the robustness floor (ISSUE 13): over-bound submits shed with a typed
+  ``Overloaded``; expired/cancelled tickets are dropped BEFORE dispatch;
+  a crashed (or hung) dispatcher is restarted by the supervisor with
+  only the in-flight batch failed; restart-budget exhaustion trips the
+  circuit breaker (typed ``ServiceUnhealthy`` at submit, sticky);
+  ``close()`` drains gracefully and never leaves a ticket blocked.
 """
 
 import dataclasses
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -279,6 +284,9 @@ def test_service_serves_a_burst_with_slo_telemetry(programs, tmp_path):
         tickets = [svc.submit(seed, psi=0.5 + 0.1 * (seed % 3))
                    for seed in range(30, 39)]
         images = [t.result(timeout=60) for t in tickets]
+        h = svc.health()
+        assert h["state"] == "ready" and h["reasons"] == []
+        assert h["dispatcher_alive"] and h["dispatcher_restarts"] == 0
     m = programs.bundle.cfg.model
     assert all(i.shape == (m.resolution, m.resolution, m.img_channels)
                for i in images)
@@ -297,33 +305,411 @@ def test_service_serves_a_burst_with_slo_telemetry(programs, tmp_path):
     assert check_serve_metric_families(prom) == []
 
 
-def test_dead_dispatcher_surfaces_at_submit(bundle):
-    """LoopWorker discipline: a dispatcher crash fails the in-flight
-    tickets AND re-raises at the next ``submit`` — never a silent
-    hang."""
-    from gansformer_tpu.serve import GenerationService, ServePrograms
-    from gansformer_tpu.utils.background import BackgroundWriteError
+def _wait_until(cond, timeout=30.0, what="condition"):
+    """Poll helper for cross-thread state (dispatcher pop, monitor
+    verdicts) — asserts instead of hanging the suite."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _gated_programs(bundle, buckets=(1, 2, 4)):
+    """Programs whose synthesis blocks on an Event — the deterministic
+    way to hold the dispatcher busy while tests fill/shed/expire the
+    queue behind it."""
+    import threading
+
+    from gansformer_tpu.serve import ServePrograms
+
+    gate = threading.Event()
+
+    class Gated(ServePrograms):
+        def synthesize(self, ws, psi, rng):
+            gate.wait(20)
+            return super().synthesize(ws, psi, rng)
+
+    return Gated(bundle, buckets=buckets, manifest_dir=None), gate
+
+
+def test_dispatcher_crash_trips_breaker_and_surfaces_typed(bundle):
+    """The self-healing floor's last line: with a zero restart budget a
+    dispatcher crash trips the circuit breaker — the in-flight ticket
+    fails (not hangs), every later ``submit`` raises a typed
+    ``ServiceUnhealthy`` (sticky: a tripped breaker never silently
+    recovers), and ``health()`` reports unhealthy."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import (
+        GenerationService, ServePrograms, ServiceUnhealthy)
 
     class Boom(ServePrograms):
         def map_seeds(self, seeds, label=None):
             raise RuntimeError("device on fire")
 
     svc = GenerationService(Boom(bundle, buckets=(1,), manifest_dir=None),
-                            max_fill_wait_ms=0.0)
+                            max_fill_wait_ms=0.0,
+                            max_dispatcher_restarts=0,
+                            restart_backoff_base_s=0.01)
     t = svc.submit(1)
     with pytest.raises(RuntimeError, match="generation request failed"):
         t.result(timeout=30)
-    svc._worker.join(30)
-    # sticky FOREVER: a dead loop never recovers, so every later
-    # submitter must see the crash — not just the first one
+    _wait_until(lambda: svc.health()["state"] == "unhealthy",
+                what="breaker trip")
     for _ in range(2):
-        with pytest.raises(BackgroundWriteError, match="dispatch"):
+        with pytest.raises(ServiceUnhealthy, match="circuit breaker"):
             svc.submit(2)
+    assert telemetry.gauge("serve/health_state").value == 2
+    assert not svc.health()["dispatcher_alive"]
     svc.close()
 
 
+def test_dispatcher_self_heals_through_injected_crash(programs):
+    """ISSUE 13 chaos acceptance (tier-1 shape): an injected
+    ``raise@serve_dispatch`` kills the dispatcher mid-traffic; the
+    supervisor restarts it under backoff, only the in-flight batch
+    fails, later requests are served, and ``health()`` reports the
+    restart."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import GenerationService
+    from gansformer_tpu.supervise import faults
+
+    restarts0 = telemetry.counter("serve/dispatcher_restarts_total").value
+    faults.arm(faults.parse_specs("raise@serve_dispatch:batch=2"))
+    try:
+        svc = GenerationService(programs, max_fill_wait_ms=0.0,
+                                restart_backoff_base_s=0.01)
+        ok1 = svc.submit(881).result(timeout=60)
+        t2 = svc.submit(882)
+        with pytest.raises(RuntimeError, match="generation request failed"):
+            t2.result(timeout=60)
+        ok3 = svc.submit(883).result(timeout=60)   # served post-restart
+        assert ok1.shape == ok3.shape
+        h = svc.health()
+        assert h["state"] == "degraded"
+        assert h["dispatcher_restarts"] == 1
+        assert any("restart" in r for r in h["reasons"])
+        svc.close()
+        assert telemetry.counter(
+            "serve/dispatcher_restarts_total").value == restarts0 + 1
+    finally:
+        faults.disarm()
+
+
+def test_breaker_trips_on_persistent_failure_with_budget(bundle):
+    """A permanently-broken device with a NONZERO restart budget must
+    still trip: crashed dispatch attempts are not progress (only
+    fulfilled batches reset the count), so back-to-back failures walk
+    through the budget and open the breaker instead of crash-looping
+    forever."""
+    from gansformer_tpu.serve import (
+        GenerationService, ServePrograms, ServiceUnhealthy)
+
+    class Boom(ServePrograms):
+        def map_seeds(self, seeds, label=None):
+            raise RuntimeError("device on fire")
+
+    svc = GenerationService(Boom(bundle, buckets=(1,), manifest_dir=None),
+                            max_fill_wait_ms=0.0,
+                            max_dispatcher_restarts=2,
+                            restart_backoff_base_s=0.01)
+    tickets = []
+    for seed in range(1, 4):               # three consecutive deaths
+        try:
+            tickets.append(svc.submit(seed))
+        except ServiceUnhealthy:
+            break
+        with pytest.raises(RuntimeError):
+            tickets[-1].result(timeout=30)
+    _wait_until(lambda: svc.health()["state"] == "unhealthy",
+                what="breaker trip after budget walk-through")
+    with pytest.raises(ServiceUnhealthy, match="circuit breaker"):
+        svc.submit(9)
+    svc.close()
+
+
+def test_breaker_counts_back_to_back_deaths_not_lifetime(programs):
+    """Progress between deaths resets the breaker count: a service that
+    crashes, recovers and SERVES, then crashes again never trips a
+    budget of 1 — only back-to-back no-progress deaths escalate."""
+    from gansformer_tpu.serve import GenerationService
+    from gansformer_tpu.supervise import faults
+
+    faults.arm(faults.parse_specs(
+        "raise@serve_dispatch:batch=2,raise@serve_dispatch:batch=4"))
+    try:
+        svc = GenerationService(programs, max_fill_wait_ms=0.0,
+                                max_dispatcher_restarts=1,
+                                restart_backoff_base_s=0.01)
+        for seed in (771, 772, 773, 774, 775):   # batches 1..5
+            try:
+                svc.submit(seed).result(timeout=60)
+            except RuntimeError:
+                pass                             # the two injected crashes
+        h = svc.health()
+        assert h["state"] == "degraded", h      # NOT unhealthy
+        assert h["dispatcher_restarts"] == 2
+        assert np.isfinite(
+            np.float32(svc.submit(776).result(timeout=60))).all()
+        svc.close()
+    finally:
+        faults.disarm()
+
+
+def test_hung_dispatcher_abandoned_and_replaced(programs):
+    """An injected ``hang@serve_dispatch`` wedges the dispatcher on one
+    batch; the hang watchdog abandons the thread, fails the in-flight
+    ticket with a typed error, and a replacement serves the next
+    request."""
+    from gansformer_tpu.serve import GenerationService, ServiceUnhealthy
+    from gansformer_tpu.supervise import faults
+
+    faults.arm(faults.parse_specs("hang@serve_dispatch:batch=1"))
+    try:
+        svc = GenerationService(programs, max_fill_wait_ms=0.0,
+                                restart_backoff_base_s=0.01,
+                                hang_after_s=0.3,
+                                hang_startup_grace_s=0.3)
+        t1 = svc.submit(771)
+        with pytest.raises(ServiceUnhealthy, match="hung"):
+            t1.result(timeout=30)
+        assert np.isfinite(
+            np.float32(svc.submit(772).result(timeout=60))).all()
+        assert svc.health()["dispatcher_restarts"] == 1
+        svc.close()
+    finally:
+        faults.disarm()
+
+
+def test_overload_sheds_typed_with_zero_hung_tickets(bundle):
+    """ISSUE 13 overload acceptance: with the dispatcher held busy,
+    submissions beyond the queue bound shed DETERMINISTICALLY with a
+    typed ``Overloaded`` (counted in ``serve/shed_total``), health
+    degrades with a saturation reason, and once the gate opens every
+    ACCEPTED ticket still resolves — zero hung tickets."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import GenerationService, Overloaded
+
+    p, gate = _gated_programs(bundle)
+    shed0 = telemetry.counter("serve/shed_total").value
+    svc = GenerationService(p, max_fill_wait_ms=0.0, max_queue_depth=4)
+    try:
+        first = svc.submit(10)
+        _wait_until(lambda: not svc._pending and svc._busy_since
+                    is not None, what="first batch in flight")
+        accepted = [svc.submit(11 + i) for i in range(4)]
+        for i in range(12):                 # 4x the bound, beyond it
+            with pytest.raises(Overloaded, match="shed"):
+                svc.submit(100 + i)
+        assert telemetry.counter("serve/shed_total").value == shed0 + 12
+        h = svc.health()
+        assert h["state"] == "degraded"
+        assert any("saturated" in r for r in h["reasons"])
+        gate.set()
+        imgs = [t.result(timeout=60) for t in [first] + accepted]
+        assert all(np.isfinite(np.float32(i)).all() for i in imgs)
+        assert all(t.state == "done" for t in [first] + accepted)
+    finally:
+        gate.set()
+        svc.close()
+    assert svc.health()["queue_depth"] == 0
+
+
+def test_expired_requests_dropped_before_dispatch(bundle):
+    """A ticket whose deadline passes while queued resolves with a
+    typed ``Expired`` at pop time — never padded into a bucket (the
+    mapping program is not dispatched for it)."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import Expired, GenerationService
+
+    p, gate = _gated_programs(bundle, buckets=(1, 2))
+    exp0 = telemetry.counter("serve/expired_total").value
+    maps0 = telemetry.counter("serve/map_dispatch_total").value
+    svc = GenerationService(p, max_fill_wait_ms=0.0)
+    try:
+        t1 = svc.submit(331)
+        _wait_until(lambda: not svc._pending and svc._busy_since
+                    is not None, what="first batch in flight")
+        t2 = svc.submit(332, deadline_s=0.02)
+        time.sleep(0.1)                    # t2 expires while queued
+        gate.set()
+        assert np.isfinite(np.float32(t1.result(timeout=60))).all()
+        with pytest.raises(Expired, match="deadline"):
+            t2.result(timeout=60)
+        assert telemetry.counter("serve/expired_total").value == exp0 + 1
+        # only t1 was mapped: the expired ticket never reached dispatch
+        assert telemetry.counter(
+            "serve/map_dispatch_total").value == maps0 + 1
+    finally:
+        gate.set()
+        svc.close()
+
+
+def test_client_timeout_cancels_orphaned_work(bundle):
+    """Satellite 1 (orphaned work): a client whose ``result(timeout)``
+    raised marks its ticket cancelled; the dispatcher skips it at pop
+    time (``serve/cancelled_total``) instead of synthesizing an image
+    nobody will read."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import Cancelled, GenerationService
+
+    p, gate = _gated_programs(bundle, buckets=(1, 2))
+    can0 = telemetry.counter("serve/cancelled_total").value
+    svc = GenerationService(p, max_fill_wait_ms=0.0)
+    try:
+        t1 = svc.submit(441)
+        _wait_until(lambda: not svc._pending and svc._busy_since
+                    is not None, what="first batch in flight")
+        t2 = svc.submit(442)
+        with pytest.raises(TimeoutError):
+            t2.result(timeout=0.05)
+        assert t2.state == "cancelled"
+        gate.set()
+        assert np.isfinite(np.float32(t1.result(timeout=60))).all()
+        # a later request forces the queue past the cancelled ticket
+        svc.submit(443).result(timeout=60)
+        assert telemetry.counter(
+            "serve/cancelled_total").value == can0 + 1
+        with pytest.raises(Cancelled):
+            t2.result(timeout=1)
+    finally:
+        gate.set()
+        svc.close()
+
+
+def test_cancelled_tickets_free_admission_slots(bundle):
+    """Dead tickets must not shed live traffic as phantom load: with
+    the dispatcher wedged and every queued client timed out (cancelled),
+    a new submit compacts the dead slots and is ACCEPTED instead of
+    raising Overloaded."""
+    from gansformer_tpu.serve import GenerationService
+
+    p, gate = _gated_programs(bundle, buckets=(1, 2))
+    svc = GenerationService(p, max_fill_wait_ms=0.0, max_queue_depth=3)
+    try:
+        t1 = svc.submit(901)
+        _wait_until(lambda: not svc._pending and svc._busy_since
+                    is not None, what="first batch in flight")
+        queued = [svc.submit(902 + i) for i in range(3)]   # at the bound
+        for t in queued:
+            with pytest.raises(TimeoutError):
+                t.result(timeout=0.01)                     # all abandoned
+        t_live = svc.submit(909)       # compaction frees the dead slots
+        gate.set()
+        assert np.isfinite(np.float32(t_live.result(timeout=60))).all()
+        assert np.isfinite(np.float32(t1.result(timeout=60))).all()
+    finally:
+        gate.set()
+        svc.close()
+
+
+def test_bucket_quarantine_reroutes_to_next_larger(bundle):
+    """Repeated synthesis failures on one bucket quarantine it; later
+    batches route to the next-larger bucket and serve."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import GenerationService, ServePrograms
+
+    class FlakyBucket(ServePrograms):
+        def synthesize(self, ws, psi, rng):
+            if ws.shape[0] == 1:
+                raise RuntimeError("bucket-1 executable poisoned")
+            return super().synthesize(ws, psi, rng)
+
+    q0 = telemetry.counter("serve/bucket_quarantined_total").value
+    svc = GenerationService(
+        FlakyBucket(bundle, buckets=(1, 2), manifest_dir=None),
+        max_fill_wait_ms=0.0, max_dispatcher_restarts=5,
+        restart_backoff_base_s=0.01, quarantine_after=2)
+    try:
+        for seed in (551, 552):            # two consecutive b1 failures
+            with pytest.raises(RuntimeError,
+                               match="generation request failed"):
+                svc.submit(seed).result(timeout=60)
+        img = svc.submit(553).result(timeout=60)   # rerouted to b2
+        assert np.isfinite(np.float32(img)).all()
+        h = svc.health()
+        assert h["quarantined_buckets"] == [1]
+        assert any("quarantined" in r for r in h["reasons"])
+        assert telemetry.counter(
+            "serve/bucket_quarantined_total").value == q0 + 1
+    finally:
+        svc.close()
+
+
+def test_graceful_drain_serves_queue_and_leaks_no_threads(programs):
+    """ISSUE 13 drain acceptance: ``close()`` during a burst serves
+    every queued ticket within the grace window, ``serve/queue_depth``
+    returns to 0, and no service thread (dispatcher or supervisor)
+    leaks."""
+    from gansformer_tpu.obs import registry as telemetry
+    from gansformer_tpu.serve import GenerationService
+
+    svc = GenerationService(programs, max_fill_wait_ms=0.0)
+    tickets = [svc.submit(600 + i) for i in range(8)]
+    svc.close(timeout=60)
+    assert all(t.state == "done" for t in tickets)
+    assert not svc._worker.alive and not svc._monitor.is_alive()
+    assert telemetry.gauge("serve/queue_depth_now").value == 0
+    # a CLEAN close reads as closed (3), never as unhealthy — the
+    # exported gauge must not look like a tripped breaker
+    assert svc.health()["state"] == "closed"
+    assert telemetry.gauge("serve/health_state").value == 3
+    with pytest.raises(RuntimeError, match="closed"):
+        svc.submit(1)
+
+
+def test_close_past_grace_fails_leftovers_typed(bundle):
+    """A drain that can't finish inside the grace window fails the
+    in-flight batch AND the still-queued tickets with a typed
+    ``ServiceClosed`` — nothing is left blocked."""
+    from gansformer_tpu.serve import GenerationService, ServiceClosed
+
+    p, gate = _gated_programs(bundle, buckets=(1, 2))
+    svc = GenerationService(p, max_fill_wait_ms=0.0)
+    try:
+        t1 = svc.submit(661)
+        _wait_until(lambda: not svc._pending and svc._busy_since
+                    is not None, what="first batch in flight")
+        queued = [svc.submit(662 + i) for i in range(3)]
+        svc.close(timeout=0.3)             # gate still shut: can't drain
+        for t in [t1] + queued:
+            assert t.state in ("failed", "done")
+        with pytest.raises(ServiceClosed):
+            queued[-1].result(timeout=1)
+        assert svc.health()["state"] == "unhealthy"   # drain FAILED
+    finally:
+        gate.set()
+
+
+def test_close_fails_queued_after_dispatcher_death(bundle):
+    """Satellite 2: the dispatcher died between submit and close (and
+    the supervisor is still backing off) — ``close()``'s finally-path
+    fails every queued ticket with a typed error instead of leaving
+    them blocked forever."""
+    from gansformer_tpu.serve import (
+        GenerationService, ServePrograms, ServiceClosed)
+
+    class Boom(ServePrograms):
+        def map_seeds(self, seeds, label=None):
+            raise RuntimeError("device on fire")
+
+    svc = GenerationService(Boom(bundle, buckets=(1,), manifest_dir=None),
+                            max_fill_wait_ms=0.0,
+                            max_dispatcher_restarts=5,
+                            restart_backoff_base_s=60.0)   # long backoff
+    t1 = svc.submit(1)
+    with pytest.raises(RuntimeError, match="generation request failed"):
+        t1.result(timeout=30)
+    queued = [svc.submit(2), svc.submit(3)]   # dead dispatcher: queued
+    svc.close(timeout=0.5)
+    for t in queued:
+        with pytest.raises(ServiceClosed, match="closed"):
+            t.result(timeout=1)
+
+
 def test_service_close_fails_queued_tickets(programs):
-    """Tickets still queued at close() resolve with an error, not a
+    """Submitting after close() refuses with a typed error, not a
     hang."""
     from gansformer_tpu.serve import GenerationService
 
@@ -333,7 +719,97 @@ def test_service_close_fails_queued_tickets(programs):
         svc.submit(1)
 
 
+def test_serve_schema_overload_values_awareness(tmp_path):
+    """The serve-family schema lint is values-aware: when the caller
+    DROVE overload traffic (``expect_overload=True``, the chaos
+    loadtest), a shed counter still at zero is flagged — admission
+    control rotted.  Without the declaration a full-but-drained queue
+    is never flagged (filling to the bound is legitimate)."""
+    from gansformer_tpu.analysis.telemetry_schema import (
+        check_serve_metric_families)
+
+    base = {"serve_queue_depth_count": 4, "serve_queue_depth_max": 8,
+            "serve_batch_fill_count": 4, "serve_e2e_ms_count": 4,
+            "serve_requests_total": 12, "serve_images_total": 4,
+            "serve_map_dispatch_total": 1, "serve_synth_dispatch_total": 4,
+            "serve_wcache_hits_total": 0, "serve_wcache_misses_total": 4,
+            "serve_shed_total": 0, "serve_expired_total": 0,
+            "serve_cancelled_total": 0,
+            "serve_dispatcher_restarts_total": 0,
+            "serve_health_state": 0, "serve_dispatcher_alive": 1,
+            "serve_queue_bound": 8, "serve_queue_depth_now": 0}
+
+    def write(vals, name):
+        path = str(tmp_path / name)
+        with open(path, "w") as f:
+            for k, v in vals.items():
+                f.write(f"# TYPE {k} gauge\n{k} {v}\n")
+        return path
+
+    sat = write(base, "sat.prom")
+    errs = check_serve_metric_families(sat, expect_overload=True)
+    assert any("serve_shed_total never moved" in e for e in errs), \
+        "declared overload with zero sheds must be flagged"
+    # the same prom is fine when overload was not driven: a queue may
+    # fill to its bound and drain without refusing anything
+    assert check_serve_metric_families(sat) == []
+    ok = dict(base, serve_shed_total=3)
+    assert check_serve_metric_families(write(ok, "ok.prom"),
+                                       expect_overload=True) == []
+    missing = dict(base)
+    del missing["serve_expired_total"]
+    errs = check_serve_metric_families(write(missing, "miss.prom"))
+    assert any("serve_expired_total" in e for e in errs)
+
+
 # -- the load-test harness ---------------------------------------------------
+
+def _chaos_asserts(r):
+    """The chaos-artifact contract shared by the tier-1 smoke and the
+    slow full drill."""
+    assert r["hung_tickets"] == 0, "a recovery path leaked requests"
+    assert r["shed"] > 0 and r["shed_rate"] > 0
+    assert r["dispatcher_restarts"] >= 1, "injected crash never fired"
+    assert r["recovery_wave_served"] > 0, "no post-crash service"
+    assert r["served"] > 0
+    # conservation: every accepted ticket reached a terminal outcome
+    assert r["served"] + r["failed"] + r["expired"] + r["cancelled"] \
+        == r["accepted"]
+    assert r["health"]["state"] in ("ready", "degraded")
+
+
+def test_run_chaos_smoke(bundle):
+    """``run_chaos`` end-to-end on the tiny CPU proxy: deterministic
+    typed shedding under a 4x-bound burst, the injected dispatcher
+    crash self-heals, zero hung tickets, recovery measured."""
+    from scripts.loadtest_serve import run_chaos
+
+    r = run_chaos(bundle, (1, 2), queue_depth=4, burst_factor=4,
+                  crash_at_batch=2, manifest_dir=None, wcache=64,
+                  seed_universe=16, restart_backoff_s=0.01)
+    _chaos_asserts(r)
+    # burst 16 + 4-request recovery wave, both in the accounting
+    assert r["burst"] == 16 and r["submitted"] == 20
+    assert r["queue_bound"] == 4 and r["accepted"] <= r["submitted"]
+    assert r["shed_rate"] <= 1.0
+    assert np.isfinite(r["p99_ms_under_overload"])
+    assert r["p50_ms_under_overload"] <= r["p99_ms_under_overload"]
+
+
+@pytest.mark.slow
+def test_run_chaos_full_drill(bundle):
+    """The battery-shaped overload/chaos drill (larger burst, deeper
+    queue, deadlines armed) — slow-marked; the tier-1 smoke above keeps
+    the path always-green."""
+    from scripts.loadtest_serve import run_chaos
+
+    r = run_chaos(bundle, (1, 2, 4), queue_depth=16, burst_factor=4,
+                  crash_at_batch=2, deadline_s=30.0, manifest_dir=None,
+                  wcache=256, seed_universe=64,
+                  restart_backoff_s=0.05)
+    _chaos_asserts(r)
+    assert r["burst"] == 64 and r["submitted"] == 80
+
 
 def test_run_loadtest_smoke(bundle):
     """``run_loadtest`` end-to-end on the tiny CPU proxy: the artifact
