@@ -33,6 +33,11 @@ def main(argv=None) -> None:
                    choices=("xla", "pallas"),
                    help="override the attention compute backend for the "
                         "metric sweep (forward-only)")
+    p.add_argument("--conv-backend", default=None,
+                   choices=("xla", "pallas"),
+                   help="override the modulated-conv/upfirdn compute "
+                        "backend for the metric sweep (ISSUE 14; on TPU a "
+                        "failed native smoke check falls back to xla)")
     p.add_argument("--inception-npz", default=None)
     p.add_argument("--cache-dir", default=None)
     args = p.parse_args(argv)
@@ -78,6 +83,16 @@ def main(argv=None) -> None:
         cfg = dataclasses.replace(cfg, model=dataclasses.replace(
             cfg.model,
             attention_backend=resolve_backend(args.attention_backend)))
+    if args.conv_backend:
+        # Same discipline for the conv family (ISSUE 14): identical param
+        # tree, native smoke check first on TPU.
+        import dataclasses
+
+        from gansformer_tpu.ops.pallas_modconv import resolve_conv_backend
+
+        cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+            cfg.model,
+            conv_backend=resolve_conv_backend(args.conv_backend)))
     from gansformer_tpu.metrics.sweep import run_metric_sweep
 
     from gansformer_tpu.metrics.metric_base import FLAG_KEYS

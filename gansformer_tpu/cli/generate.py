@@ -46,6 +46,14 @@ def main(argv=None) -> None:
                    help="override the attention compute backend for this "
                         "forward-only run ('pallas' = fused blockwise "
                         "kernels; incompatible with --save-attention)")
+    p.add_argument("--conv-backend", default=None,
+                   choices=("xla", "pallas"),
+                   help="override the modulated-conv/upfirdn compute "
+                        "backend for this forward-only run ('pallas' = "
+                        "the fused modconv/upfirdn kernel family, "
+                        "ISSUE 14; incompatible with --save-attention — "
+                        "the overlay re-run drives the module under the "
+                        "stock XLA lowering)")
     p.add_argument("--save-attention", action="store_true",
                    help="also save latent→region attention overlays "
                         "(attn.png; needs an attention model)")
@@ -89,6 +97,23 @@ def main(argv=None) -> None:
         cfg = dataclasses.replace(cfg, model=dataclasses.replace(
             cfg.model,
             attention_backend=resolve_backend(args.attention_backend)))
+        bundle = dataclasses.replace(bundle, cfg=cfg)
+    if args.conv_backend:
+        from gansformer_tpu.ops.pallas_modconv import resolve_conv_backend
+
+        if args.save_attention and args.conv_backend != "xla":
+            # The overlay path re-runs the module with sown
+            # intermediates — an introspection path that assumes the
+            # stock XLA lowering end to end; reject rather than mix
+            # kernel backends under a debugging run (core/config.py's
+            # conv_backend validation rationale).
+            raise SystemExit(
+                "--save-attention needs the xla conv backend (the "
+                "attention-overlay re-run assumes the stock XLA "
+                "lowering); drop --conv-backend pallas")
+        cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+            cfg.model,
+            conv_backend=resolve_conv_backend(args.conv_backend)))
         bundle = dataclasses.replace(bundle, cfg=cfg)
 
     programs = ServePrograms(

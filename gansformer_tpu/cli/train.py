@@ -62,6 +62,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "kernels; on TPU a failed native smoke check "
                         "falls back to xla with the reason printed; "
                         "default: inherit the loaded config)")
+    # Same tri-state discipline for the modulated-conv/upfirdn family
+    # (ISSUE 14): 'pallas' = the fused modconv/upfirdn kernel family
+    # (ops/pallas_modconv.py) with hand-written backward kernels —
+    # training-grade to second order, resolved through its own native
+    # smoke check on TPU before any step program compiles.
+    p.add_argument("--conv-backend", default=None,
+                   choices=("xla", "pallas"),
+                   help="modulated-conv/upfirdn compute backend for the "
+                        "train step programs ('pallas' = fused "
+                        "modulate→conv→demodulate / polyphase up-conv / "
+                        "upfirdn kernels; on TPU a failed native smoke "
+                        "check falls back to xla with the reason "
+                        "printed; default: inherit the loaded config)")
     p.add_argument("--g-lr", type=float)
     p.add_argument("--d-lr", type=float)
     p.add_argument("--r1-gamma", type=float)
@@ -203,6 +216,9 @@ def config_from_args(args) -> ExperimentConfig:
     ab = getattr(args, "attention_backend", None)
     if ab is not None:            # tri-state: None inherits the config
         model = dataclasses.replace(model, attention_backend=ab)
+    cb = getattr(args, "conv_backend", None)
+    if cb is not None:            # tri-state: None inherits the config
+        model = dataclasses.replace(model, conv_backend=cb)
     train = override(cfg.train, batch_size=args.batch_size,
                      total_kimg=args.total_kimg, g_lr=args.g_lr,
                      d_lr=args.d_lr, r1_gamma=args.r1_gamma, seed=args.seed,
@@ -297,18 +313,21 @@ def main(argv=None) -> None:
     from gansformer_tpu.utils.hostenv import enable_compile_cache
 
     enable_compile_cache()   # warm second-order compiles across invocations
-    if cfg.model.attention_backend == "pallas":
-        # The smoke-check-and-fall-back discipline (ADVICE r3), now on the
-        # TRAINING entry point: resolve before any step program compiles,
-        # so a Mosaic regression costs one tiny compile + a clear message
-        # instead of a failed multi-minute second-order compile.  The
-        # resolved backend lands in the saved config.json — a resumed run
-        # re-resolves from its own record, never from a stale request.
+
+    def _resolve_pallas(cfg, field, resolver):
+        """The smoke-check-and-fall-back discipline (ADVICE r3), on the
+        TRAINING entry point: resolve before any step program compiles,
+        so a Mosaic regression costs one tiny compile + a clear message
+        instead of a failed multi-minute second-order compile.  The
+        resolved backend lands in the saved config.json — a resumed run
+        re-resolves from its own record, never from a stale request.
+        Shared by attention_backend (ISSUE 9) and conv_backend
+        (ISSUE 14)."""
+        if getattr(cfg.model, field) != "pallas":
+            return cfg
         import sys as _sys
 
-        from gansformer_tpu.ops.pallas_attention import resolve_backend
-
-        resolved = resolve_backend("pallas")
+        resolved = resolver("pallas")
         if jax.process_count() > 1:
             # Every host must land on the SAME backend: the smoke check
             # runs per-process, and a host-local failure (transient
@@ -326,12 +345,23 @@ def main(argv=None) -> None:
             if int(np.min(oks)) == 0:
                 resolved = "xla"
         if resolved != "pallas":
-            print("[train] --attention-backend pallas requested but the "
-                  "native TPU smoke check failed on at least one host "
-                  "(reason on its stderr); training continues on "
-                  "attention_backend='xla'", file=_sys.stderr)
+            flag = "--" + field.replace("_", "-")
+            print(f"[train] {flag} pallas requested but the native TPU "
+                  f"smoke check failed on at least one host (reason on "
+                  f"its stderr); training continues on {field}='xla'",
+                  file=_sys.stderr)
             cfg = dataclasses.replace(cfg, model=dataclasses.replace(
-                cfg.model, attention_backend=resolved))
+                cfg.model, **{field: resolved}))
+        return cfg
+
+    if cfg.model.attention_backend == "pallas":
+        from gansformer_tpu.ops.pallas_attention import resolve_backend
+
+        cfg = _resolve_pallas(cfg, "attention_backend", resolve_backend)
+    if cfg.model.conv_backend == "pallas":
+        from gansformer_tpu.ops.pallas_modconv import resolve_conv_backend
+
+        cfg = _resolve_pallas(cfg, "conv_backend", resolve_conv_backend)
     is_main = jax.process_index() == 0
     if run_dir is None:
         desc = args.desc or f"{cfg.name}-{cfg.model.attention}-k{cfg.model.components}"

@@ -89,6 +89,16 @@ class ModelConfig:
     # use runs the native smoke check (fwd + bwd kernels) and the CLIs
     # fall back to 'xla' with the printed reason if Mosaic lowering fails.
     attention_backend: str = "xla"
+    # 'xla' | 'pallas' — modulated-conv/upfirdn compute backend (ISSUE 14,
+    # the last StyleGAN2 custom-op family): 'pallas' runs the fused
+    # modulate→conv→demodulate, polyphase up-conv + depth-to-space, and
+    # pad→FIR→resample kernels (ops/pallas_modconv.py,
+    # ops/pallas_upfirdn.py), each with hand-written backward kernels
+    # under custom_vjp — training-grade to second order, mirroring
+    # attention_backend.  On TPU the first use runs the conv-family
+    # native smoke check (fwd + bwd) and the CLIs fall back to 'xla'
+    # with the printed reason if Mosaic lowering fails.
+    conv_backend: str = "xla"
     # MFU lever (ISSUE 5, default OFF): fuse the attention K/V projections
     # into ONE matmul per direction — the duplex centroid phase's k_x/v_x
     # both project the n = H·W grid (the expensive read at 128²), and the
@@ -332,6 +342,23 @@ class ExperimentConfig:
             errs.append("model.attention_backend='pallas' does not yet "
                         "have a sequence-parallel (model-axis-sharded) "
                         "kernel path; use attention_backend='xla' with "
+                        "sequence_parallel, or drop sequence_parallel")
+        if m.conv_backend not in ("xla", "pallas"):
+            # Mirrors attention_backend exactly: both values are
+            # training-grade (the pallas conv kernels carry backward
+            # kernels + second-order rules, ISSUE 14); a typo must fail
+            # here with the allowed set, not deep inside a trace.
+            errs.append(f"model.conv_backend must be xla|pallas, "
+                        f"got {m.conv_backend!r}")
+        if m.conv_backend == "pallas" and m.sequence_parallel:
+            # Same reasoning as the attention_backend rule above: a
+            # pallas_call has no sharding rule, so a model-axis-sharded
+            # grid would be silently all-gathered per device before
+            # every conv kernel — un-doing the memory bound sequence
+            # parallelism exists for.
+            errs.append("model.conv_backend='pallas' does not yet have "
+                        "a sequence-parallel (model-axis-sharded) kernel "
+                        "path; use conv_backend='xla' with "
                         "sequence_parallel, or drop sequence_parallel")
         if m.dtype not in ("float32", "bfloat16"):
             errs.append(f"model.dtype must be float32|bfloat16, "

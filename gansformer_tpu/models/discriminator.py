@@ -9,6 +9,7 @@ learned query vectors that aggregate region statistics from the grid.
 
 from __future__ import annotations
 
+import functools
 import math
 
 import flax.linen as nn
@@ -32,9 +33,13 @@ class Discriminator(nn.Module):
         f = cfg.blur_filter
         x = img.astype(dtype)
         n = x.shape[0]
+        # conv_backend routes the blur-pool/decimated-skip FIR legs of
+        # every residual block through the fused upfirdn kernel
+        # (ISSUE 14); the dense convs stay plain MXU contractions.
+        Conv = functools.partial(EqualConv, conv_backend=cfg.conv_backend)
 
-        x = EqualConv(cfg.nf(cfg.resolution), kernel=1, act="lrelu",
-                      dtype=dtype, name="from_rgb")(x)
+        x = Conv(cfg.nf(cfg.resolution), kernel=1, act="lrelu",
+                 dtype=dtype, name="from_rgb")(x)
 
         # D attention is independent of the generator's attention flag — it
         # only keys off d_attention + the attn resolution window.
@@ -61,18 +66,18 @@ class Discriminator(nn.Module):
                     backend=cfg.attention_backend,
                     fused_kv=cfg.attn_fused_kv,
                     dtype=dtype, name=f"b{res}_attn")(x, y)
-            t = EqualConv(x.shape[-1], act="lrelu", resample_filter=f,
-                          dtype=dtype, name=f"b{res}_conv0")(x)
-            t = EqualConv(nf_out, down=2, act="lrelu", resample_filter=f,
-                          dtype=dtype, name=f"b{res}_conv1")(t)
-            skip = EqualConv(nf_out, kernel=1, down=2, use_bias=False,
-                             resample_filter=f, dtype=dtype,
-                             name=f"b{res}_skip")(x)
+            t = Conv(x.shape[-1], act="lrelu", resample_filter=f,
+                     dtype=dtype, name=f"b{res}_conv0")(x)
+            t = Conv(nf_out, down=2, act="lrelu", resample_filter=f,
+                     dtype=dtype, name=f"b{res}_conv1")(t)
+            skip = Conv(nf_out, kernel=1, down=2, use_bias=False,
+                        resample_filter=f, dtype=dtype,
+                        name=f"b{res}_skip")(x)
             x = (t + skip) * (1.0 / math.sqrt(2.0))
 
         # 4×4 head
         x = minibatch_stddev(x, cfg.mbstd_group_size, cfg.mbstd_num_features)
-        x = EqualConv(cfg.nf(4), act="lrelu", dtype=dtype, name="head_conv")(x)
+        x = Conv(cfg.nf(4), act="lrelu", dtype=dtype, name="head_conv")(x)
         x = x.reshape(n, -1)
         x = EqualDense(cfg.nf(2), act="lrelu", dtype=dtype, name="head_fc")(x)
         if cfg.label_dim > 0:

@@ -61,6 +61,11 @@ class EqualConv(nn.Module):
     act: str = "linear"
     resample_filter: tuple = (1, 3, 3, 1)
     dtype: jnp.dtype = jnp.float32
+    # 'xla' | 'pallas' (ModelConfig.conv_backend, ISSUE 14): 'pallas'
+    # routes this layer's FIR resampling legs (blur-pool, decimated
+    # skip) through the fused pad→FIR→resample kernel; the dense conv
+    # itself stays a plain MXU contraction either way.
+    conv_backend: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -71,7 +76,8 @@ class EqualConv(nn.Module):
         coef = self.gain / math.sqrt(fan_in) * self.lrmul
         y = conv2d(x.astype(self.dtype), (w * coef).astype(self.dtype),
                    up=self.up, down=self.down,
-                   resample_filter=self.resample_filter)
+                   resample_filter=self.resample_filter,
+                   backend=self.conv_backend)
         b = None
         if self.use_bias:
             b = self.param("b", nn.initializers.zeros,
@@ -92,6 +98,11 @@ class ModulatedConv(nn.Module):
     act: str = "lrelu"
     resample_filter: tuple = (1, 3, 3, 1)
     dtype: jnp.dtype = jnp.float32
+    # 'xla' (jnp composite) or 'pallas' (the fused kernel family of
+    # ops/pallas_modconv.py — modulate→conv→demodulate in one kernel,
+    # polyphase up-conv + depth-to-space fused, blur + bias/act on the
+    # fused upfirdn kernel; training-grade to second order, ISSUE 14).
+    conv_backend: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, w_style: jax.Array,
@@ -104,18 +115,36 @@ class ModulatedConv(nn.Module):
                             (self.kernel, self.kernel, cin, self.features),
                             jnp.float32)
         coef = 1.0 / math.sqrt(cin * self.kernel**2)
-        y = modulated_conv2d(x.astype(self.dtype),
-                             (weight * coef).astype(self.dtype),
-                             styles, demodulate=self.demodulate, up=self.up,
-                             resample_filter=self.resample_filter)
         assert noise_mode in ("random", "none"), f"bad noise_mode {noise_mode!r}"
-        if self.use_noise and noise_mode != "none":
+        add_noise = self.use_noise and noise_mode != "none"
+        b = self.param("b", nn.initializers.zeros, (self.features,), jnp.float32)
+        if self.conv_backend == "pallas":
+            from gansformer_tpu.ops import modulated_conv2d_pallas
+
+            # Noise sits between demod and bias/act, so the bias/act
+            # epilogue fuses into the final kernel only on the
+            # noise-free paths (tRGB always; everything at
+            # noise_mode='none').
+            y = modulated_conv2d_pallas(
+                x.astype(self.dtype), (weight * coef).astype(self.dtype),
+                styles, demodulate=self.demodulate, up=self.up,
+                resample_filter=self.resample_filter,
+                bias=None if add_noise else b,
+                act=None if add_noise else self.act)
+            if not add_noise:
+                return y
+        else:
+            y = modulated_conv2d(x.astype(self.dtype),
+                                 (weight * coef).astype(self.dtype),
+                                 styles, demodulate=self.demodulate,
+                                 up=self.up,
+                                 resample_filter=self.resample_filter)
+        if add_noise:
             strength = self.param("noise_strength", nn.initializers.zeros,
                                   (), jnp.float32)
             noise = jax.random.normal(self.make_rng("noise"),
                                       y.shape[:3] + (1,), dtype=self.dtype)
             y = y + noise * strength.astype(self.dtype)
-        b = self.param("b", nn.initializers.zeros, (self.features,), jnp.float32)
         return fused_bias_act(y, b, act=self.act)
 
 

@@ -20,6 +20,7 @@ Style routing (``cfg.style_mode``):
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import flax.linen as nn
@@ -62,7 +63,9 @@ class SynthesisNetwork(nn.Module):
 
         # No per-block remat here, deliberately: measured to INCREASE the
         # second-order-grad workspace at ffhq1024 (PERF.md §2a).
-        Conv, Attn = ModulatedConv, BipartiteAttention
+        Attn = BipartiteAttention
+        Conv = functools.partial(ModulatedConv,
+                                 conv_backend=cfg.conv_backend)
 
         # Running conv style: starts at the global latent; in 'attention'
         # mode each attention block folds its refined latents in, so convs
@@ -99,10 +102,10 @@ class SynthesisNetwork(nn.Module):
                                       nn.initializers.zeros, (), jnp.float32)
                     w_style = w_global + gate * w_attn
             # tRGB skip: modulated 1×1, no demod, linear act.
-            t = ModulatedConv(cfg.img_channels, kernel=1, demodulate=False,
-                              use_noise=False, act="linear", dtype=dtype,
-                              name=f"b{res}_trgb")(x, w_style,
-                                                   noise_mode="none")
-            rgb = t if rgb is None else upsample_2d(rgb, f) + t
+            t = Conv(cfg.img_channels, kernel=1, demodulate=False,
+                     use_noise=False, act="linear", dtype=dtype,
+                     name=f"b{res}_trgb")(x, w_style, noise_mode="none")
+            rgb = (t if rgb is None
+                   else upsample_2d(rgb, f, backend=cfg.conv_backend) + t)
 
         return rgb.astype(jnp.float32)

@@ -18,6 +18,16 @@ _PALLAS_EXPORTS = (
     "latent_to_grid_attention",
     "multihead_attention_pallas",
 )
+# conv_backend='pallas' kernel family (ISSUE 14) — same lazy discipline.
+_PALLAS_CONV_EXPORTS = (
+    "modulated_conv2d_pallas",
+    "modconv_fits",
+    "resolve_conv_backend",
+)
+_PALLAS_UPFIRDN_EXPORTS = (
+    "upfirdn2d_pallas",
+    "upfirdn_fits",
+)
 
 
 def __getattr__(name):
@@ -27,4 +37,12 @@ def __getattr__(name):
         from gansformer_tpu.ops import pallas_attention
 
         return getattr(pallas_attention, name)
+    if name in _PALLAS_CONV_EXPORTS:
+        from gansformer_tpu.ops import pallas_modconv
+
+        return getattr(pallas_modconv, name)
+    if name in _PALLAS_UPFIRDN_EXPORTS:
+        from gansformer_tpu.ops import pallas_upfirdn
+
+        return getattr(pallas_upfirdn, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -99,7 +99,8 @@ def _conv_transpose_poly(x: jax.Array, w: jax.Array) -> jax.Array:
 
 
 def conv2d(x: jax.Array, w: jax.Array, up: int = 1, down: int = 1,
-           resample_filter: Sequence[float] = (1, 3, 3, 1)) -> jax.Array:
+           resample_filter: Sequence[float] = (1, 3, 3, 1),
+           backend: str = "xla") -> jax.Array:
     """Plain conv with optional FIR-filtered up/down-sampling.
 
     Capability match for the reference's ``conv2d_layer`` with
@@ -115,6 +116,11 @@ def conv2d(x: jax.Array, w: jax.Array, up: int = 1, down: int = 1,
     """
     assert x.ndim == 4 and w.ndim == 4
     kh, kw = w.shape[0], w.shape[1]
+    # backend='pallas' (ISSUE 14): the FIR legs of every resampling chain
+    # ride the fused pad→FIR→resample kernel; the dense k×k convs stay on
+    # XLA here (they are plain MXU contractions — the kernel win on this
+    # path is the bandwidth-bound blur/decimate legs).  The modulated
+    # path's fully-fused kernels live in ops/pallas_modconv.py.
     if up == 2 and down == 1 and kh == kw == 3:
         y = _conv_transpose_poly(x, w)
         # Anti-imaging blur AFTER the transposed conv (reference order),
@@ -122,11 +128,12 @@ def conv2d(x: jax.Array, w: jax.Array, up: int = 1, down: int = 1,
         # filter_2d's centered padding lands on the same phase as the
         # blur-first pipeline — interior equality is pinned by
         # tests/test_ops.py::test_conv2d_up_polyphase_matches_blur_first.
-        return filter_2d(y, resample_filter, gain=float(up * up))
+        return filter_2d(y, resample_filter, gain=float(up * up),
+                         backend=backend)
     if up > 1:
         # General fallback: zero-insert upsample + anti-imaging blur, then
         # the conv at the higher resolution.
-        x = upsample_2d(x, resample_filter, factor=up)
+        x = upsample_2d(x, resample_filter, factor=up, backend=backend)
     if down > 1:
         f = setup_filter(resample_filter)
         if kh == kw == 1:
@@ -140,7 +147,8 @@ def conv2d(x: jax.Array, w: jax.Array, up: int = 1, down: int = 1,
             # Identical taps/positions to blur-then-stride — the 1×1 conv
             # commutes with decimation exactly.
             p = f.shape[0] - down
-            x = upfirdn2d(x, f, down=down, pad=((p + 1) // 2, p // 2))
+            x = upfirdn2d(x, f, down=down, pad=((p + 1) // 2, p // 2),
+                          backend=backend)
             return _conv(x, w, stride=1, padding="VALID")
         # k>1: every blurred pixel is read by some stride-``down`` window,
         # so there is nothing to decimate; fold the VALID conv's padding
@@ -148,7 +156,7 @@ def conv2d(x: jax.Array, w: jax.Array, up: int = 1, down: int = 1,
         # conv kernel instead — one 6×6 dense conv — costs 4× the dense
         # MACs; rejected, PERF.md §1b''''.)
         p = (f.shape[0] - down) + (kh - 1)
-        x = upfirdn2d(x, f, pad=((p + 1) // 2, p // 2))
+        x = upfirdn2d(x, f, pad=((p + 1) // 2, p // 2), backend=backend)
         return _conv(x, w, stride=down, padding="VALID")
     return _conv(x, w, stride=1, padding="SAME")
 

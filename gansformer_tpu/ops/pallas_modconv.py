@@ -1,0 +1,763 @@
+"""Pallas TPU kernels for the modulated-conv family — the last StyleGAN2
+custom-CUDA-op family (SURVEY.md §2.1) lowered by hand instead of stock
+XLA (``conv_backend='pallas'``, ROADMAP item 1's next attribution tier).
+
+Three fused kernels, each a drop-in for one link of the
+``ops/modulated_conv.py`` chain:
+
+``same`` (3×3 / 1×1)  — **modulate → conv → demodulate** in one kernel:
+    the per-sample style scale ``s`` (over in-channels) and the demod
+    ``rsqrt`` scale ``d`` (over out-channels) are folded into the weight
+    tile in fp32 and cast once, so the conv rides the MXU in the compute
+    dtype (bf16 on the flagship) while the x·s and y·d elementwise
+    round-trips never touch HBM.  The k² taps unroll as shifted VMEM
+    slices feeding one [H·W, Ci]×[Ci, Co-block] matmul each, accumulated
+    in fp32.  Optional fused ``act(y + bias) * gain`` epilogue
+    (linear/lrelu — the only activations the models fuse).
+
+``poly`` (up=2, 3×3)   — **polyphase up-conv + depth-to-space**: the
+    four output phases are computed as 2×2-tap matmuls at the LOW
+    resolution (``_conv_transpose_poly``'s math) and interleaved to the
+    2H×2W grid inside the kernel — the [N, H, W, 4·Co] phase tensor of
+    the XLA chain never exists in HBM.  The anti-imaging blur (+ the
+    bias/act epilogue) then rides ``ops/pallas_upfirdn.py``'s fused
+    kernel, completing the `_conv_transpose_poly → reshape →
+    fused_bias_act` chain as kernels end to end.
+
+backward kernels       — dx via the transposed conv through the SAME
+    generic kernel (fold ``d`` into the adjoint weights, emit
+    ``ds = Σ_hw x ⊙ u`` from the same pass), dw via a per-tap
+    accumulation kernel (fp32 VMEM scratch across the batch grid axis,
+    the dk/dv discipline of ``pallas_attention``).
+
+Autodiff contract — the PR-9 layering, verbatim
+(``ops/pallas_attention.py`` module docstring, docs/pallas.md):
+
+* ``_mc_core`` is a ``jax.custom_vjp`` whose bwd runs the backward
+  kernels — first-order reverse (the d/g step programs) executes
+  kernels only.
+* ``_mc_fwd`` / ``_mc_grads`` are ``jax.custom_jvp`` composites: primal
+  via decorated recursion into the kernels, tangent via ``jax.jvp`` of
+  the jnp reference (`_ref_*`) — transposable glue, so R1 grad-of-grad
+  and PL HVPs re-enter rules.
+* The demodulation coefficient ``d = rsqrt(Σ (w·s)² + ε)`` is computed
+  OUTSIDE the custom rules by the same differentiable fp32 einsum the
+  XLA path uses and passed as a traced argument — the chain rule routes
+  the demod sensitivity (∂d/∂w, ∂d/∂s) through plain jnp autodiff, so
+  the hand-written kernels only ever differentiate the multilinear core
+  ``y = d ⊙ conv(s ⊙ x, w)``.
+
+Oversized grids (a per-sample image block that cannot fit VMEM even at
+one output channel — ffhq1024's ≥512² layers) fall back to the XLA
+composite per call; ``modconv_fits`` is the static gate, and
+docs/pallas.md records the limit.  On TPU, first use runs
+``tpu_smoke_check`` (fwd AND bwd kernels, upfirdn included) and the
+CLIs fall back to ``conv_backend='xla'`` with the printed reason if
+Mosaic lowering fails — the same discipline as the attention backend.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # importable on CPU builds
+
+from gansformer_tpu.ops.fused_bias_act import ACTIVATIONS, fused_bias_act
+from gansformer_tpu.ops.modulated_conv import (_conv, _conv_transpose_poly,
+                                               modulated_conv2d)
+from gansformer_tpu.ops.pallas_upfirdn import (upfirdn_fits, upfirdn2d_pallas)
+from gansformer_tpu.ops.upfirdn2d import filter_2d, setup_filter
+
+# Per-invocation VMEM budget.  The whole-image per-sample block is
+# double-buffered by the pipeline, so the fit rule below charges fixed
+# (unblocked) inputs TWICE against this.  Grids whose blocks cannot fit
+# even at one output channel (ffhq1024's ≥512² layers; the flagship's
+# 256² dense convs at bf16) fall back to the XLA composite per call —
+# the honest limit docs/pallas.md records (halo row-blocking is the
+# named follow-up); the channel-blocked upfirdn kernels have no fixed
+# block and cover every grid.
+_VMEM_BUDGET = 14 * 2**20
+
+# Supported fused epilogues and their inverses (for the backward's
+# activation recovery from the saved output; lrelu is sign-preserving
+# under its positive gain, so act'(u) is a function of y).
+_FUSED_ACTS = ("linear", "lrelu")
+
+
+def _act_apply(y32, act, alpha, gain):
+    fn, _ = ACTIVATIONS[act]
+    return fn(y32, alpha) * gain
+
+
+def _act_dy(y32, act, alpha):
+    """act'(u) recovered from the post-act value y."""
+    if act == "linear":
+        return jnp.ones_like(y32)
+    return jnp.where(y32 >= 0, 1.0, alpha)
+
+
+def _act_inv(y32, act, alpha, gain):
+    """u = act⁻¹(y / gain)."""
+    y32 = y32 / gain
+    if act == "linear":
+        return y32
+    return jnp.where(y32 >= 0, y32, y32 / alpha)
+
+
+def _precision(dtype):
+    return (lax.Precision.HIGHEST if dtype == jnp.float32
+            else lax.Precision.DEFAULT)
+
+
+def _fit_blocks(co: int, per_cb: int, fixed: int) -> Optional[int]:
+    """Largest divisor of ``co`` with 2·fixed + per_cb·cb ≤ budget (the
+    fixed whole-image block is double-buffered by the pipeline)."""
+    if 2 * fixed + per_cb > _VMEM_BUDGET:
+        return None
+    cb = co
+    while cb > 1 and 2 * fixed + per_cb * cb > _VMEM_BUDGET:
+        cb -= 1
+        while co % cb:
+            cb -= 1
+    return cb
+
+
+# --------------------------------------------------------------------------
+# Weight/tap preparation (wrapper-side jnp on the SMALL weight tensors)
+# --------------------------------------------------------------------------
+
+
+def _poly_w4(w: jax.Array) -> jax.Array:
+    """[3,3,Ci,Co] → [4, Ci, Co*4] phase sub-kernels, tap-major, with the
+    flattened output axis laid out co-OUTER / phase-INNER (co*4 + a*2 + b)
+    so an output-channel block slice stays contiguous."""
+    ci, co = w.shape[2], w.shape[3]
+    w_pad = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    dh = np.arange(2)
+    a = np.arange(2)
+    rh = np.where(2 * dh[:, None] + 1 - a[None, :] < 3,
+                  2 * dh[:, None] + 1 - a[None, :], 3)       # [dh, a]
+    w4 = w_pad[rh[:, None, :, None], rh[None, :, None, :]]   # [dh,dw,a,b,Ci,Co]
+    w4 = w4.transpose(0, 1, 4, 5, 2, 3)                      # [dh,dw,Ci,Co,a,b]
+    return w4.reshape(2, 2, ci, co * 4).reshape(4, ci, co * 4)
+
+
+# The (row, (dh, a)) inverse of the polyphase tap mapping for k=3: each
+# real weight row r is read by exactly one (dh, a) pair (row 3 is the
+# structural-zero pad).  Used to fold dw4 back to dw.
+_POLY_ROW_SRC = {0: (0, 1), 1: (0, 0), 2: (1, 1)}
+
+
+def _poly_dw_fold(dw4: jax.Array, ci: int, co: int) -> jax.Array:
+    """[4, Ci, Co*4] tap-major phase grads → [3,3,Ci,Co] (inverse gather
+    of the ``_poly_w4`` tap mapping; the pad row's grads are dropped —
+    those taps are structural zeros)."""
+    g = dw4.reshape(2, 2, ci, co, 2, 2)        # [dh,dw,Ci,Co,a,b]
+    rows = []
+    for r1 in range(3):
+        dh1, a1 = _POLY_ROW_SRC[r1]
+        cols = []
+        for r2 in range(3):
+            dh2, a2 = _POLY_ROW_SRC[r2]
+            cols.append(g[dh1, dh2, :, :, a1, a2])
+        rows.append(jnp.stack(cols, axis=0))
+    return jnp.stack(rows, axis=0)             # [3,3,Ci,Co]
+
+
+def _space_to_depth(du: jax.Array) -> jax.Array:
+    """[N,2H,2W,Co] → [N,H,W,Co*4] with the co-outer/phase-inner layout
+    matching ``_poly_w4``."""
+    n, h2, w2, co = du.shape
+    h, w = h2 // 2, w2 // 2
+    return (du.reshape(n, h, 2, w, 2, co)
+            .transpose(0, 1, 3, 5, 2, 4)
+            .reshape(n, h, w, co * 4))
+
+
+def _geom(kind: str):
+    """(offs, pads, phases) of the forward kernel — static per kind."""
+    if kind == "same3":
+        return (tuple((a, b) for a in range(3) for b in range(3)),
+                ((1, 1), (1, 1)), 1)
+    if kind == "same1":
+        return (((0, 0),), ((0, 0), (0, 0)), 1)
+    assert kind == "poly"
+    return (((0, 0), (0, 1), (1, 0), (1, 1)), ((0, 1), (0, 1)), 4)
+
+
+def _prep(kind: str, w: jax.Array):
+    """(offs, pads, phases, wstack [T, Cin_k, CoutK]) for the forward."""
+    offs, pads, phases = _geom(kind)
+    if kind == "same3":
+        return offs, pads, phases, w.reshape(9, w.shape[2], w.shape[3])
+    if kind == "same1":
+        return offs, pads, phases, w.reshape(1, w.shape[2], w.shape[3])
+    return offs, pads, phases, _poly_w4(w)
+
+
+def _prep_adjoint(kind: str, w: jax.Array):
+    """(offs, pads, wT [T, CoutK, Cin_k]) of the transposed conv the
+    dx/ds kernel runs (spatial flip + channel transpose)."""
+    ci, co = w.shape[2], w.shape[3]
+    if kind == "same3":
+        wf = jnp.flip(w, (0, 1)).transpose(0, 1, 3, 2)     # [3,3,Co,Ci]
+        return (tuple((a, b) for a in range(3) for b in range(3)),
+                ((1, 1), (1, 1)), wf.reshape(9, co, ci))
+    if kind == "same1":
+        return (((0, 0),), ((0, 0), (0, 0)),
+                w.transpose(0, 1, 3, 2).reshape(1, co, ci))
+    assert kind == "poly"
+    w4 = _poly_w4(w).reshape(2, 2, ci, co * 4)             # tap [dh,dw]
+    offs, wts = [], []
+    for dh in range(2):
+        for dw_ in range(2):
+            offs.append((1 - dh, 1 - dw_))
+            wts.append(w4[dh, dw_].T)                      # [Co*4, Ci]
+    return (tuple(offs), ((1, 0), (1, 0)), jnp.stack(wts, axis=0))
+
+
+# --------------------------------------------------------------------------
+# Kernels
+# --------------------------------------------------------------------------
+
+
+def _fwd_body(x_ref, w_ref, pre_ref, post_ref, b_ref, o_ref, *, offs, oh,
+              ow, phases, act, alpha, gain, precision):
+    x = x_ref[0]                                         # [Hp, Wp, Ci]
+    ci = x.shape[-1]
+    pre = pre_ref[0].astype(jnp.float32)                 # [Ci]
+    post = post_ref[0].astype(jnp.float32)               # [cbK]
+    cbk = post.shape[0]
+    acc = jnp.zeros((oh * ow, cbk), jnp.float32)
+    for t, (oy, ox) in enumerate(offs):
+        xt = lax.slice(x, (oy, ox, 0),
+                       (oy + oh, ox + ow, ci)).reshape(oh * ow, ci)
+        # Style + demod folded into the weight tile in fp32, cast ONCE to
+        # the compute dtype — the conv itself rides the MXU in bf16.
+        wt = (w_ref[t].astype(jnp.float32)
+              * pre[:, None] * post[None, :]).astype(x.dtype)
+        acc = acc + lax.dot(xt, wt, precision=precision,
+                            preferred_element_type=jnp.float32)
+    if phases == 4:
+        cb = cbk // 4
+        # depth-to-space interleave in VMEM: [oh,ow,cb,a,b] → [2oh,2ow,cb]
+        y = (acc.reshape(oh, ow, cb, 2, 2)
+             .transpose(0, 3, 1, 4, 2)
+             .reshape(2 * oh, 2 * ow, cb))
+    else:
+        y = acc.reshape(oh, ow, cbk)
+    if act is not None:
+        y = _act_apply(y + b_ref[0].astype(jnp.float32), act, alpha, gain)
+    o_ref[0] = y.astype(o_ref.dtype)
+
+
+def _bwd_body(dy_ref, w_ref, pre_ref, post_ref, x_ref, dx_ref, ds_ref, *,
+              offs, oh, ow, precision):
+    dy = dy_ref[0]                                       # [Hp', Wp', CoK]
+    cok = dy.shape[-1]
+    pre = pre_ref[0].astype(jnp.float32)                 # [CoK] (demod d)
+    post = post_ref[0].astype(jnp.float32)               # [cb]  (style s)
+    cb = post.shape[0]
+    u = jnp.zeros((oh * ow, cb), jnp.float32)
+    for t, (oy, ox) in enumerate(offs):
+        dt = lax.slice(dy, (oy, ox, 0),
+                       (oy + oh, ox + ow, cok)).reshape(oh * ow, cok)
+        wt = (w_ref[t].astype(jnp.float32) * pre[:, None]).astype(dy.dtype)
+        u = u + lax.dot(dt, wt, precision=precision,
+                        preferred_element_type=jnp.float32)
+    # dx = s ⊙ u; ds = Σ_hw x ⊙ u — one pass, two outputs.
+    dx_ref[0] = (u * post[None, :]).reshape(oh, ow, cb).astype(dx_ref.dtype)
+    x = x_ref[0].reshape(oh * ow, cb).astype(jnp.float32)
+    ds_ref[0] = jnp.sum(x * u, axis=0)
+
+
+def _dw_body(x_ref, dy_ref, pre_ref, post_ref, dw_ref, acc_ref, *, offs,
+             oh, ow, precision):
+    i = pl.program_id(1)                 # batch index (fastest grid axis)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[0]                                         # [Hp, Wp, Ci]
+    ci = x.shape[-1]
+    pre = pre_ref[0].astype(jnp.float32)                 # [Ci]
+    post = post_ref[0].astype(jnp.float32)               # [cbK]
+    dy = dy_ref[0].reshape(oh * ow, post.shape[0])
+    # The per-sample modulation scales FACTOR OUT of the spatial
+    # contraction: dw_n[t] = (s ⊗ d) ⊙ (xᵀ dy) — applying the rank-1
+    # scale to the [Ci, cb] tap result avoids materializing a modulated
+    # copy of the whole image block in VMEM.
+    scale = pre[:, None] * post[None, :]
+    for t, (oy, ox) in enumerate(offs):
+        xt = lax.slice(x, (oy, ox, 0),
+                       (oy + oh, ox + ow, ci)).reshape(oh * ow, ci)
+        acc_ref[t] += scale * lax.dot_general(
+            xt, dy, dimension_numbers=(((0,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=jnp.float32)
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _emit():
+        dw_ref[:] = acc_ref[:].astype(dw_ref.dtype)
+
+
+# --------------------------------------------------------------------------
+# Kernel call wrappers (grid/blocking decided here, from static shapes)
+# --------------------------------------------------------------------------
+
+
+def _pad_hw(x, pads):
+    (py0, py1), (px0, px1) = pads
+    if py0 or py1 or px0 or px1:
+        return jnp.pad(x, ((0, 0), (py0, py1), (px0, px1), (0, 0)))
+    return x
+
+
+def _itemsize(dt):
+    return jnp.dtype(dt).itemsize
+
+
+def _fwd_call(x, wstack, pre, post, b, *, offs, pads, phases, act,
+              alpha, gain, interpret):
+    n, h, w, ci = x.shape
+    t, _, cok_full = wstack.shape
+    co = cok_full // phases
+    oh, ow = h, w
+    up = 2 if phases == 4 else 1
+    xp = _pad_hw(x, pads)
+    hp, wp = xp.shape[1], xp.shape[2]
+    it = _itemsize(x.dtype)
+    fixed = hp * wp * ci * it
+    per_cb = phases * (oh * ow * (4 + it)                # accumulator + out
+                       + t * ci * (4 + it))              # weight tile + copy
+    cb = _fit_blocks(co, per_cb, fixed)
+    assert cb is not None, "caller must gate on modconv_fits()"
+    cbk = cb * phases
+    kern = functools.partial(
+        _fwd_body, offs=offs, oh=oh, ow=ow, phases=phases, act=act,
+        alpha=alpha, gain=gain, precision=_precision(x.dtype))
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n, up * oh, up * ow, co), x.dtype),
+        grid=(n, co // cb),
+        in_specs=[
+            pl.BlockSpec((1, hp, wp, ci), lambda i, j: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, ci, cbk), lambda i, j: (0, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ci), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cbk), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cb), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, up * oh, up * ow, cb),
+                               lambda i, j: (i, 0, 0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(xp, wstack, pre, post, b.reshape(1, co))
+
+
+def _bwd_call(du4, wT, pre, post, x, *, offs, pads, interpret):
+    """dx/ds of the core at cotangent ``du4`` (phase-folded for poly):
+    the transposed conv through the generic kernel.  ``pre`` = demod d
+    (over the adjoint's in-channels), ``post`` = style s (over Ci)."""
+    n, h, w, ci = x.shape
+    t, cok, _ = wT.shape
+    dup = _pad_hw(du4, pads)
+    hp, wp = dup.shape[1], dup.shape[2]
+    it = _itemsize(x.dtype)
+    fixed = hp * wp * cok * it
+    per_cb = h * w * (4 + 2 * it) + t * cok * (4 + it)
+    cb = _fit_blocks(ci, per_cb, fixed)
+    assert cb is not None, "caller must gate on modconv_fits()"
+    kern = functools.partial(_bwd_body, offs=offs, oh=h, ow=w,
+                             precision=_precision(x.dtype))
+    dx, ds = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((n, h, w, ci), x.dtype),
+                   jax.ShapeDtypeStruct((n, ci), jnp.float32)),
+        grid=(n, ci // cb),
+        in_specs=[
+            pl.BlockSpec((1, hp, wp, cok), lambda i, j: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, cok, cb), lambda i, j: (0, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cok), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cb), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h, w, cb), lambda i, j: (i, 0, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(pl.BlockSpec((1, h, w, cb), lambda i, j: (i, 0, 0, j),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, cb), lambda i, j: (i, j),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(dup, wT, pre, post, x)
+    return dx, ds
+
+
+def _dw_call(x, du4, pre, post, *, offs, pads, t, interpret, out_dtype):
+    """dw of the core: per-tap [Ci, CoK] accumulation across the batch
+    grid axis in fp32 VMEM scratch (emitted at the last batch step)."""
+    n, h, w, ci = x.shape
+    cok = du4.shape[-1]
+    xp = _pad_hw(x, pads)
+    hp, wp = xp.shape[1], xp.shape[2]
+    it = _itemsize(x.dtype)
+    fixed = hp * wp * ci * it
+    per_cb = h * w * it + t * ci * 8                     # dy + acc/out
+    cb = _fit_blocks(cok, per_cb, fixed)
+    assert cb is not None, "caller must gate on modconv_fits()"
+    kern = functools.partial(_dw_body, offs=offs, oh=h, ow=w,
+                             precision=_precision(x.dtype))
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((t, ci, cok), out_dtype),
+        grid=(cok // cb, n),
+        in_specs=[
+            pl.BlockSpec((1, hp, wp, ci), lambda j, i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h, w, cb), lambda j, i: (i, 0, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ci), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cb), lambda j, i: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((t, ci, cb), lambda j, i: (0, 0, j),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((t, ci, cb), jnp.float32)],
+        interpret=interpret,
+    )(xp, du4, pre, post)
+
+
+def modconv_fits(x_shape: Tuple[int, ...], w_shape: Tuple[int, ...],
+                 up: int = 1, itemsize: int = 4) -> bool:
+    """Static VMEM-fit gate for the kernel family at these shapes (the
+    fwd AND both backward kernels must fit at one output channel —
+    training needs all three; fixed whole-image blocks count twice for
+    the pipeline's double buffering).  False → the dispatcher falls
+    back to the XLA composite for this call (docs/pallas.md records the
+    limit)."""
+    _, h, w, ci = x_shape
+    kh = w_shape[0]
+    co = w_shape[3]
+    phases = 4 if up == 2 else 1
+    t = 4 if up == 2 else kh * kh
+    it = itemsize
+    hp, wp = h + kh - 1, w + kh - 1
+    cok = co * phases
+    # adjoint input: SAME-padded dy (same kinds) or the space-to-depth
+    # fold of the 2H×2W cotangent, left-padded (poly)
+    bwd_fixed = ((h + 1) * (w + 1) * cok * it if up == 2
+                 else hp * wp * cok * it)
+    checks = [
+        # fwd: x block + one-channel accumulator/weights/output
+        (hp * wp * ci * it,
+         phases * (h * w * (4 + it) + t * ci * (4 + it))),
+        # bwd dx/ds: full adjoint input (CoK channels) + one-ci-channel
+        (bwd_fixed, h * w * (4 + 2 * it) + t * cok * (4 + it)),
+        # dw: x block + one-channel dy/acc (scales factor out — no
+        # modulated image copy, see _dw_body)
+        (hp * wp * ci * it, h * w * it + t * ci * 8),
+    ]
+    return all(2 * fixed + per <= _VMEM_BUDGET for fixed, per in checks)
+
+
+# --------------------------------------------------------------------------
+# jnp reference formulas (oracle + tangent glue)
+# --------------------------------------------------------------------------
+
+
+def _ref_core(x, w, s, d, kind):
+    xs = x * s.astype(x.dtype)[:, None, None, :]
+    y = (_conv_transpose_poly(xs, w) if kind == "poly"
+         else _conv(xs, w.astype(x.dtype)))
+    return y * d.astype(y.dtype)[:, None, None, :]
+
+
+def _ref_full(x, w, s, d, b, kind, act, alpha, gain):
+    y = _ref_core(x, w, s, d, kind)
+    if act is None:
+        return y
+    return fused_bias_act(y, b, act=act, alpha=alpha, gain=gain)
+
+
+def _ref_core_grads(x, w, s, d, du, kind):
+    _, vjp = jax.vjp(
+        lambda x_, w_, s_, d_: _ref_core(x_, w_, s_, d_, kind), x, w, s, d)
+    dx, dw, ds, _ = vjp(du)
+    return dx, dw, ds
+
+
+# --------------------------------------------------------------------------
+# Derivative rules (PR-9 layering)
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(5, 6))
+def _mc_fwd(x, w, s, d, b, spec, interpret):
+    kind, act, alpha, gain = spec
+    offs, pads, phases, wstack = _prep(kind, w)
+    post = jnp.repeat(d, 4, axis=1) if kind == "poly" else d
+    return _fwd_call(x, wstack, s, post, b, offs=offs, pads=pads,
+                     phases=phases, act=act, alpha=alpha, gain=gain,
+                     interpret=interpret)
+
+
+@_mc_fwd.defjvp
+def _mc_fwd_jvp(spec, interpret, primals, tangents):
+    kind, act, alpha, gain = spec
+    out = _mc_fwd(*primals, spec, interpret)
+    _, tan = jax.jvp(
+        lambda x, w, s, d, b: _ref_full(x, w, s, d, b, kind, act, alpha,
+                                        gain),
+        primals, tangents)
+    return out, tan
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(5, 6))
+def _mc_grads(x, w, s, d, du, kind, interpret):
+    offs_a, pads_a, wT = _prep_adjoint(kind, w)
+    offs_f, pads_f, _ = _geom(kind)
+    if kind == "poly":
+        du4 = _space_to_depth(du)
+        pre = jnp.repeat(d, 4, axis=1)
+    else:
+        du4, pre = du, d
+    dx, ds = _bwd_call(du4, wT, pre, s, x, offs=offs_a, pads=pads_a,
+                       interpret=interpret)
+    t = len(offs_f)
+    dwt = _dw_call(x, du4, s, pre, offs=offs_f, pads=pads_f, t=t,
+                   interpret=interpret, out_dtype=jnp.float32)
+    if kind == "poly":
+        dw = _poly_dw_fold(dwt, x.shape[-1], w.shape[3])
+    else:
+        dw = dwt.reshape(w.shape)
+    return dx, dw.astype(w.dtype), ds
+
+
+@_mc_grads.defjvp
+def _mc_grads_jvp(kind, interpret, primals, tangents):
+    out = _mc_grads(*primals, kind, interpret)
+    _, tan = jax.jvp(
+        lambda x, w, s, d, du: _ref_core_grads(x, w, s, d, du, kind),
+        primals, tangents)
+    return out, tan
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _mc_core(x, w, s, d, b, spec, interpret):
+    return _mc_fwd(x, w, s, d, b, spec, interpret)
+
+
+def _mc_core_fwd(x, w, s, d, b, spec, interpret):
+    y = _mc_fwd(x, w, s, d, b, spec, interpret)
+    return y, (x, w, s, d, b, y)
+
+
+def _mc_core_bwd(spec, interpret, res, ct):
+    kind, act, alpha, gain = spec
+    x, w, s, d, b, y = res
+    y32 = y.astype(jnp.float32)
+    ct32 = ct.astype(jnp.float32)
+    if act is None:
+        du32, db, c = ct32, jnp.zeros_like(b), y32
+    else:
+        # Activation recovery from the saved output (plain jnp glue —
+        # transposable, so the reg programs' second-order passes close).
+        du32 = ct32 * _act_dy(y32, act, alpha) * gain
+        db = jnp.sum(du32, axis=(0, 1, 2)).astype(b.dtype)
+        c = _act_inv(y32, act, alpha, gain) - b.astype(jnp.float32)
+    # dd = Σ_hw du ⊙ conv(s⊙x, w) — the pre-demod conv recovered from the
+    # saved output (c = y_core = d ⊙ conv), so no recompute pass.
+    dd = (jnp.sum(du32 * c, axis=(1, 2))
+          / d.astype(jnp.float32)).astype(d.dtype)
+    dx, dw, ds = _mc_grads(x, w, s, d, du32.astype(ct.dtype), kind,
+                           interpret)
+    return dx, dw, ds.astype(s.dtype), dd, db
+
+
+_mc_core.defvjp(_mc_core_fwd, _mc_core_bwd)
+
+
+# --------------------------------------------------------------------------
+# Public op — drop-in for ops.modulated_conv.modulated_conv2d
+# --------------------------------------------------------------------------
+
+
+def modulated_conv2d_pallas(
+    x: jax.Array,                 # [N, H, W, Cin]
+    w: jax.Array,                 # [kh, kw, Cin, Cout]
+    styles: jax.Array,            # [N, Cin]
+    demodulate: bool = True,
+    up: int = 1,
+    down: int = 1,
+    resample_filter=(1, 3, 3, 1),
+    eps: float = 1e-8,
+    *,
+    bias: Optional[jax.Array] = None,
+    act: Optional[str] = None,
+    alpha: float = 0.2,
+    gain: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused modulate→conv→demodulate through the Pallas kernel family,
+    with an optional fused ``act(y + bias) * gain`` epilogue.
+
+    Same math as ``modulated_conv2d`` (+ ``fused_bias_act`` when the
+    epilogue is passed); differentiable to second order.  Unsupported
+    geometries (down-sampling, kernels other than 1×1/3×3, up≠{1,2}) and
+    VMEM-oversized grids fall back to the XLA composite per call.
+    """
+    assert x.ndim == 4 and w.ndim == 4 and styles.ndim == 2
+    n, _, _, cin = x.shape
+    kh, kw = w.shape[0], w.shape[1]
+    co = w.shape[3]
+    assert w.shape[2] == cin and styles.shape == (n, cin)
+    # Same contract as upfirdn2d_pallas, enforced on EVERY dispatch path
+    # (a bias would otherwise be silently dropped on the act-less kernel
+    # epilogue): a caller porting from fused_bias_act must say
+    # act='linear' explicitly.
+    assert act is not None or bias is None, \
+        "bias without act: pass act='linear'"
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if act is not None and act not in _FUSED_ACTS:
+        y = modulated_conv2d_pallas(
+            x, w, styles, demodulate=demodulate, up=up, down=down,
+            resample_filter=resample_filter, eps=eps, interpret=interpret)
+        return fused_bias_act(y, bias, act=act, alpha=alpha, gain=gain)
+    supported = (down == 1 and kh == kw
+                 and ((up == 1 and kh in (1, 3)) or (up == 2 and kh == 3))
+                 and modconv_fits(x.shape, w.shape, up,
+                                  jnp.dtype(x.dtype).itemsize))
+    if not supported:
+        y = modulated_conv2d(x, w, styles, demodulate=demodulate, up=up,
+                             down=down, resample_filter=resample_filter,
+                             eps=eps)
+        if act is not None:
+            y = fused_bias_act(y, bias, act=act, alpha=alpha, gain=gain)
+        return y
+
+    # Demod coefficients by the SAME differentiable fp32 einsum as the
+    # XLA path — passed as a traced arg so the custom rules only handle
+    # the multilinear core (module docstring).
+    s32 = styles.astype(jnp.float32)
+    if demodulate:
+        sigma = jnp.einsum("hwio,ni->no", jnp.square(w.astype(jnp.float32)),
+                           jnp.square(s32), precision=lax.Precision.HIGHEST)
+        d = lax.rsqrt(sigma + eps)
+    else:
+        d = jnp.ones((n, co), jnp.float32)
+
+    g = (ACTIVATIONS[act][1] if act is not None and gain is None
+         else (gain if gain is not None else 1.0))
+    b32 = (jnp.zeros((co,), jnp.float32) if bias is None
+           else bias.astype(jnp.float32))
+
+    if up == 1:
+        kind = "same1" if kh == 1 else "same3"
+        spec = (kind, act, alpha, float(g))
+        return _mc_core(x, w, s32, d, b32, spec, interpret)
+
+    # up == 2: fused polyphase + depth-to-space kernel, demod folded,
+    # then the anti-imaging blur (+ the epilogue) on the fused upfirdn
+    # kernel — the full XLA chain `_conv_transpose_poly → reshape →
+    # filter_2d → fused_bias_act` as kernels end to end.
+    y = _mc_core(x, w, s32, d, jnp.zeros((co,), jnp.float32),
+                 ("poly", None, alpha, 1.0), interpret)
+    f = setup_filter(resample_filter, gain=float(up * up))
+    p = f.shape[0] - 1
+    pad4 = ((p + 1) // 2, p // 2, (p + 1) // 2, p // 2)
+    if upfirdn_fits(y.shape, f.shape, 1, 1, pad4):
+        return upfirdn2d_pallas(y, f, pad=pad4, bias=bias, act=act,
+                                alpha=alpha, gain=gain, interpret=interpret)
+    y = filter_2d(y, resample_filter, gain=float(up * up))
+    if act is not None:
+        y = fused_bias_act(y, bias, act=act, alpha=alpha, gain=gain)
+    return y
+
+
+# --------------------------------------------------------------------------
+# First-use native-TPU verification gate + resolution (ADVICE r3 — the
+# same discipline as ops.pallas_attention.resolve_backend)
+# --------------------------------------------------------------------------
+
+_TPU_SMOKE: dict = {}
+
+
+def tpu_smoke_check(atol: float = 1e-2) -> tuple:
+    """Native compile-and-compare of the conv kernel family (fwd AND the
+    backward kernels via ``jax.grad``, upfirdn included) against the XLA
+    composites at tiny shapes.  Memoized; returns ``(ok, detail)``."""
+    if "ok" in _TPU_SMOKE:
+        return _TPU_SMOKE["ok"], _TPU_SMOKE["detail"]
+    import numpy as _np
+
+    from gansformer_tpu.ops.upfirdn2d import upfirdn2d as _ufd_xla
+
+    try:
+        rng = _np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 8, 8, 8), jnp.float32)
+        w = jnp.asarray(rng.randn(3, 3, 8, 16) * 0.2, jnp.float32)
+        s = jnp.asarray(rng.randn(2, 8) * 0.3 + 1.0, jnp.float32)
+        f = setup_filter((1, 3, 3, 1))
+        diffs = []
+        for up in (1, 2):
+            ref = modulated_conv2d(x, w, s, up=up)
+            got = modulated_conv2d_pallas(x, w, s, up=up, interpret=False)
+            diffs.append(float(jnp.max(jnp.abs(got - ref))))
+
+            def loss(fn):
+                return lambda x_, w_, s_: jnp.sum(
+                    jnp.square(fn(x_, w_, s_)))
+
+            g_ref = jax.grad(loss(lambda *a: modulated_conv2d(*a, up=up)),
+                             argnums=(0, 1, 2))(x, w, s)
+            g_got = jax.grad(
+                loss(lambda *a: modulated_conv2d_pallas(
+                    *a, up=up, interpret=False)),
+                argnums=(0, 1, 2))(x, w, s)
+            diffs.append(max(float(jnp.max(jnp.abs(a - b)))
+                             for a, b in zip(g_got, g_ref)))
+        ref_u = _ufd_xla(x, f, up=2, pad=(2, 1))
+        got_u = upfirdn2d_pallas(x, f, up=2, pad=(2, 1), interpret=False)
+        diffs.append(float(jnp.max(jnp.abs(got_u - ref_u))))
+        ok = max(diffs) < atol
+        detail = (f"max_abs_diff modconv fwd/bwd up1={diffs[0]:.2e}/"
+                  f"{diffs[1]:.2e} up2={diffs[2]:.2e}/{diffs[3]:.2e} "
+                  f"upfirdn={diffs[4]:.2e} (atol {atol:g})")
+    except Exception as e:  # Mosaic compile failures surface as many types
+        ok = False
+        detail = f"native compile/run failed: {type(e).__name__}: {e}"[:400]
+    _TPU_SMOKE.update(ok=ok, detail=detail)
+    return ok, detail
+
+
+def resolve_conv_backend(requested: str) -> str:
+    """'pallas' → 'pallas' only if safe on this backend, else 'xla' —
+    the conv-family twin of ``pallas_attention.resolve_backend``."""
+    if requested != "pallas":
+        return requested
+    if jax.default_backend() != "tpu":
+        return "pallas"
+    ok, detail = tpu_smoke_check()
+    if ok:
+        return "pallas"
+    import sys
+
+    print(f"[pallas] native TPU conv smoke check FAILED ({detail}); "
+          f"falling back to the xla conv backend", file=sys.stderr)
+    return "xla"

@@ -1,0 +1,340 @@
+"""Pallas TPU kernel for upfirdn2d — pad → FIR → resample in ONE fused
+kernel, differentiable to second order (``conv_backend='pallas'``).
+
+The XLA path (``ops/upfirdn2d.py``) lowers the whole op to one
+``conv_general_dilated``; this module is the hand-scheduled alternative
+for the same semantics: per (batch, channel-block) grid step the kernel
+loads one image block into VMEM, performs zero-insertion + padding +
+cropping with a single ``lax.pad`` (interior dilation = the upsample,
+negative edges = the crop), walks the FIR taps as strided VMEM slices
+accumulated in fp32, and writes the decimated result — the padded
+intermediate and the pre-decimation grid never touch HBM.  The filter is
+a static compile-time constant (it always is in this codebase: blur
+taps from ``setup_filter``), so the tap loop fully unrolls.
+
+Optional fused epilogue: ``act(y + bias) * gain`` (linear/lrelu) rides
+the same kernel — the `_conv_transpose_poly → blur → fused_bias_act`
+chain of the up-conv path collapses into kernels end to end.
+
+Autodiff contract (the PR-9 pattern, ``ops/pallas_attention.py``):
+
+* upfirdn is LINEAR in ``x``; its exact adjoint is another upfirdn with
+  the flipped filter, ``up``/``down`` swapped, and the reference's
+  gradient padding (the custom TF gradient of
+  ``src/dnnlib/tflib/ops/upfirdn_2d.py``).  The outer ``jax.custom_vjp``
+  therefore runs the SAME forward kernel for the backward pass.
+* The kernel composite is a ``jax.custom_jvp`` function whose rule
+  computes the primal via the kernel (decorated recursion peels one
+  transform level) and the tangent via the jnp/XLA reference — plain
+  transposable glue, so R1 grad-of-grad and PL HVPs re-enter rules
+  instead of dying at an untransposable ``pallas_call``.
+* The filter is non-differentiable (a static resampling constant, as in
+  the reference); ``bias`` is differentiable through saved-output
+  activation recovery (lrelu is invertible given the sign).
+
+Tests run the kernels in interpret mode on CPU against the XLA op and
+the numpy oracle (tests/test_pallas_conv.py); on TPU first use runs
+``pallas_modconv.tpu_smoke_check`` (this kernel is part of the conv
+family gate) and the CLIs fall back to the xla conv backend if Mosaic
+lowering fails.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # importable on CPU builds
+
+from gansformer_tpu.ops.upfirdn2d import (_pad4 as _xla_pad4,
+                                          upfirdn2d as _xla_upfirdn2d)
+
+# Conservative per-invocation VMEM working-set budget (bytes).  The
+# wrapper shrinks the channel block until the fp32 compute footprint of
+# one grid step fits; if even one channel cannot fit (huge grids) the
+# CALLER is expected to fall back to the XLA op.
+_VMEM_BUDGET = 9 * 2**20
+
+_SQRT2 = math.sqrt(2.0)
+# act -> (apply(pre), default gain, recover dpre/dy from the SAVED
+# post-act output).  Only the activations the models actually fuse
+# (models/layers.py uses linear + lrelu); everything else stays an XLA
+# epilogue.
+_EPILOGUES = {
+    "linear": (lambda u, a: u, 1.0,
+               lambda y, a, g: jnp.ones_like(y)),
+    "lrelu": (lambda u, a: jnp.where(u >= 0, u, u * a), _SQRT2,
+              lambda y, a, g: jnp.where(y >= 0, 1.0, a).astype(y.dtype)),
+}
+
+
+def _out_hw(h: int, w: int, fh: int, fw: int, up: int, down: int,
+            pad4: Tuple[int, int, int, int]) -> Tuple[int, int]:
+    py0, py1, px0, px1 = pad4
+    oh = (h * up + py0 + py1 - fh) // down + 1
+    ow = (w * up + px0 + px1 - fw) // down + 1
+    assert oh > 0 and ow > 0, (h, w, fh, fw, up, down, pad4)
+    return oh, ow
+
+
+def grad_pad4(in_h: int, in_w: int, fh: int, fw: int, up: int, down: int,
+              pad4: Tuple[int, int, int, int]) -> Tuple[int, int, int, int]:
+    """Padding of the adjoint upfirdn (flipped filter, up↔down swapped) —
+    the reference custom gradient's pad algebra, validated against
+    ``jax.grad`` of the XLA op in tests/test_pallas_conv.py."""
+    py0, py1, px0, px1 = pad4
+    oh, ow = _out_hw(in_h, in_w, fh, fw, up, down, pad4)
+    return (fh - py0 - 1, in_h * up - oh * down + py0 - up + 1,
+            fw - px0 - 1, in_w * up - ow * down + px0 - up + 1)
+
+
+def _pick_block_c(h: int, w: int, c: int, fh: int, fw: int, up: int,
+                  down: int, pad4: Tuple[int, int, int, int]) -> Optional[int]:
+    """Largest divisor of ``c`` whose one-step fp32 footprint (padded
+    input + output + one tap slice) fits the budget; None = does not fit
+    even at one channel (caller falls back to XLA)."""
+    oh, ow = _out_hw(h, w, fh, fw, up, down, pad4)
+    ph = h * up + max(pad4[0], 0) + max(pad4[1], 0)
+    pw = w * up + max(pad4[2], 0) + max(pad4[3], 0)
+    per_c = 4 * (h * w + ph * pw + 2 * oh * ow)
+    if per_c > _VMEM_BUDGET:
+        return None
+    bc = c
+    while bc > 1 and per_c * bc > _VMEM_BUDGET:
+        bc -= 1
+        while c % bc:
+            bc -= 1
+    return bc
+
+
+def _upfirdn_body(x_ref, b_ref, o_ref, *, f, up, down, pad4, act, alpha,
+                  gain):
+    py0, py1, px0, px1 = pad4
+    x = x_ref[0].astype(jnp.float32)                    # [H, W, bc]
+    # ONE lax.pad: interior dilation = zero-insertion upsample, negative
+    # edge padding = crop.  upfirdn places up-1 zeros AFTER every sample
+    # (including the last) — interior dilation stops at the last sample,
+    # so the missing trailing zeros fold into the high edge pad, exactly
+    # like the XLA wrapper's lhs_dilation bookkeeping.
+    xp = lax.pad(x, jnp.float32(0),
+                 ((py0, py1 + up - 1, up - 1),
+                  (px0, px1 + up - 1, up - 1),
+                  (0, 0, 0)))
+    fh, fw = f.shape
+    oh = (xp.shape[0] - fh) // down + 1
+    ow = (xp.shape[1] - fw) // down + 1
+    bc = x.shape[-1]
+    ff = f[::-1, ::-1]                                  # true convolution
+    acc = jnp.zeros((oh, ow, bc), jnp.float32)
+    for a in range(fh):                                 # static unroll
+        for b in range(fw):
+            tap = float(ff[a, b])
+            if tap == 0.0:
+                continue
+            sl = lax.slice(xp, (a, b, 0),
+                           (a + (oh - 1) * down + 1,
+                            b + (ow - 1) * down + 1, bc),
+                           (down, down, 1))
+            acc = acc + tap * sl
+    if act is not None:
+        fn, _, _ = _EPILOGUES[act]
+        acc = fn(acc + b_ref[0].astype(jnp.float32), alpha) * gain
+    o_ref[0] = acc.astype(o_ref.dtype)
+
+
+def _upfirdn_kernel(x_ref, b_ref, o_ref, **kw):
+    _upfirdn_body(x_ref, b_ref, o_ref, **kw)
+
+
+def _upfirdn_kernel_nobias(x_ref, o_ref, **kw):
+    _upfirdn_body(x_ref, None, o_ref, **kw)
+
+
+def _ufd_call(x: jax.Array, f: np.ndarray, up: int, down: int,
+              pad4: Tuple[int, int, int, int], bias: Optional[jax.Array],
+              act: Optional[str], alpha: float, gain: float,
+              interpret: bool) -> jax.Array:
+    n, h, w, c = x.shape
+    fh, fw = f.shape
+    oh, ow = _out_hw(h, w, fh, fw, up, down, pad4)
+    bc = _pick_block_c(h, w, c, fh, fw, up, down, pad4)
+    assert bc is not None, "caller must gate on upfirdn_fits()"
+    grid = (n, c // bc)
+    kern = functools.partial(
+        _upfirdn_kernel if bias is not None else _upfirdn_kernel_nobias,
+        f=f, up=up, down=down, pad4=pad4, act=act, alpha=alpha, gain=gain)
+    in_specs = [pl.BlockSpec((1, h, w, bc), lambda i, j: (i, 0, 0, j),
+                             memory_space=pltpu.VMEM)]
+    args = [x]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, bc), lambda i, j: (0, j),
+                                     memory_space=pltpu.VMEM))
+        args.append(bias.reshape(1, c))
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n, oh, ow, c), x.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, oh, ow, bc), lambda i, j: (i, 0, 0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(*args)
+
+
+def upfirdn_fits(x_shape: Tuple[int, ...], f_shape: Tuple[int, int],
+                 up: int, down: int,
+                 pad4: Tuple[int, int, int, int]) -> bool:
+    """Static VMEM-fit verdict for this call — the dispatch gate callers
+    use before choosing the pallas path (False → XLA composite)."""
+    _, h, w, c = x_shape
+    return _pick_block_c(h, w, c, f_shape[0], f_shape[1], up, down,
+                         pad4) is not None
+
+
+# --------------------------------------------------------------------------
+# Derivative rules (PR-9 layering: custom_vjp over kernel-running
+# custom_jvp composites; tangents are jnp/XLA reference glue).
+# --------------------------------------------------------------------------
+
+
+def _f_np(f_tup) -> np.ndarray:
+    return np.asarray(f_tup, np.float32)
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _ufd_plain(x, f_tup, up, down, pad4, interpret):
+    return _ufd_call(x, _f_np(f_tup), up, down, pad4, None, None, 0.0,
+                     1.0, interpret)
+
+
+@_ufd_plain.defjvp
+def _ufd_plain_jvp(f_tup, up, down, pad4, interpret, primals, tangents):
+    (x,), (tx,) = primals, tangents
+    out = _ufd_plain(x, f_tup, up, down, pad4, interpret)
+    # upfirdn is linear: the tangent is the op applied to the tangent —
+    # via the XLA reference so further transforms (the reg programs'
+    # transposes) stay closed.
+    tan = _xla_upfirdn2d(tx, _f_np(f_tup), up=up, down=down, pad=pad4)
+    return out, tan
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def _ufd(x, f_tup, up, down, pad4, gpad4, interpret):
+    return _ufd_plain(x, f_tup, up, down, pad4, interpret)
+
+
+def _ufd_fwd_rule(x, f_tup, up, down, pad4, gpad4, interpret):
+    return _ufd(x, f_tup, up, down, pad4, gpad4, interpret), None
+
+
+def _ufd_bwd_rule(f_tup, up, down, pad4, gpad4, interpret, res, ct):
+    del res
+    f_flip = tuple(tuple(row) for row in _f_np(f_tup)[::-1, ::-1])
+    return (_ufd_plain(ct, f_flip, down, up, gpad4, interpret),)
+
+
+_ufd.defvjp(_ufd_fwd_rule, _ufd_bwd_rule)
+
+
+def _ref_with_epilogue(x, b, f_np, up, down, pad4, act, alpha, gain):
+    from gansformer_tpu.ops.fused_bias_act import fused_bias_act
+
+    y = _xla_upfirdn2d(x, f_np, up=up, down=down, pad=pad4)
+    return fused_bias_act(y, b, act=act, alpha=alpha, gain=gain)
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+def _ufd_ba_plain(x, b, f_tup, up, down, pad4, act, alpha, gain, interpret):
+    return _ufd_call(x, _f_np(f_tup), up, down, pad4, b, act, alpha, gain,
+                     interpret)
+
+
+@_ufd_ba_plain.defjvp
+def _ufd_ba_plain_jvp(f_tup, up, down, pad4, act, alpha, gain, interpret,
+                      primals, tangents):
+    out = _ufd_ba_plain(*primals, f_tup, up, down, pad4, act, alpha, gain,
+                        interpret)
+    _, tan = jax.jvp(
+        lambda x, b: _ref_with_epilogue(x, b, _f_np(f_tup), up, down, pad4,
+                                        act, alpha, gain),
+        primals, tangents)
+    return out, tan
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9,
+                                                    10))
+def _ufd_ba(x, b, f_tup, up, down, pad4, gpad4, act, alpha, gain, interpret):
+    return _ufd_ba_plain(x, b, f_tup, up, down, pad4, act, alpha, gain,
+                         interpret)
+
+
+def _ufd_ba_fwd_rule(x, b, f_tup, up, down, pad4, gpad4, act, alpha, gain,
+                     interpret):
+    y = _ufd_ba(x, b, f_tup, up, down, pad4, gpad4, act, alpha, gain,
+                interpret)
+    return y, (y,)
+
+
+def _ufd_ba_bwd_rule(f_tup, up, down, pad4, gpad4, act, alpha, gain,
+                     interpret, res, ct):
+    # Activation recovery from the SAVED post-act output (lrelu keeps the
+    # sign through the positive gain), then the linear adjoint kernel —
+    # all glue is plain jnp, so R1/PL transposes close over this rule.
+    (y,) = res
+    _, _, dact = _EPILOGUES[act]
+    du = (ct.astype(jnp.float32) * dact(y.astype(jnp.float32), alpha, gain)
+          * gain)
+    db = jnp.sum(du, axis=(0, 1, 2)).astype(jnp.float32)
+    f_flip = tuple(tuple(row) for row in _f_np(f_tup)[::-1, ::-1])
+    dx = _ufd_plain(du.astype(ct.dtype), f_flip, down, up, gpad4, interpret)
+    return dx, db
+
+
+_ufd_ba.defvjp(_ufd_ba_fwd_rule, _ufd_ba_bwd_rule)
+
+
+# --------------------------------------------------------------------------
+# Public op
+# --------------------------------------------------------------------------
+
+
+def upfirdn2d_pallas(x: jax.Array, f, up: int = 1, down: int = 1,
+                     pad=0, *, bias: Optional[jax.Array] = None,
+                     act: Optional[str] = None, alpha: float = 0.2,
+                     gain: Optional[float] = None,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """Fused pad→FIR→resample kernel; drop-in for ``ops.upfirdn2d`` with
+    an optional fused ``act(y + bias) * gain`` epilogue (linear/lrelu).
+
+    ``f`` must be a static (numpy) filter — it always is in this
+    codebase.  Differentiable to second order in ``x`` (and ``bias``);
+    ``interpret=None`` auto-selects interpret mode off-TPU, mirroring
+    ``models/attention.py``'s backend dispatch.
+    """
+    assert x.ndim == 4, "expected NHWC"
+    f_np = np.asarray(f, np.float32)
+    assert f_np.ndim == 2, "2D filter (setup_filter output) required"
+    pad4 = _xla_pad4(pad)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, h, w, c = x.shape
+    f_tup = tuple(tuple(float(v) for v in row) for row in f_np)
+    gpad4 = grad_pad4(h, w, f_np.shape[0], f_np.shape[1], up, down, pad4)
+    if act is None:
+        assert bias is None, "bias without act: pass act='linear'"
+        return _ufd(x, f_tup, up, down, pad4, gpad4, interpret)
+    assert act in _EPILOGUES, (
+        f"fused epilogue supports {sorted(_EPILOGUES)}, got {act!r} — "
+        f"apply other activations via ops.fused_bias_act after the kernel")
+    g = _EPILOGUES[act][1] if gain is None else gain
+    b = (jnp.zeros((c,), jnp.float32) if bias is None
+         else bias.astype(jnp.float32))
+    return _ufd_ba(x, b, f_tup, up, down, pad4, gpad4, act, alpha, float(g),
+                   interpret)

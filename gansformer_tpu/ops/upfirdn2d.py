@@ -64,7 +64,7 @@ def _pad4(pad: Pad) -> Tuple[int, int, int, int]:
 
 
 def upfirdn2d(x: jax.Array, f, up: int = 1, down: int = 1,
-              pad: Pad = 0) -> jax.Array:
+              pad: Pad = 0, backend: str = "xla") -> jax.Array:
     """Upsample, pad, FIR-filter and downsample a batch of NHWC images.
 
     Semantics (matching the reference wrapper's docstring):
@@ -72,8 +72,21 @@ def upfirdn2d(x: jax.Array, f, up: int = 1, down: int = 1,
       2. zero-pad by ``pad`` = (pady0, pady1, padx0, padx1) (negative crops),
       3. convolve with the 2D FIR filter ``f`` (true convolution),
       4. keep every ``down``-th sample.
+
+    ``backend='pallas'`` routes through the fused pad→FIR→resample
+    kernel (``ops/pallas_upfirdn.py``, ISSUE 14) when this call's VMEM
+    footprint fits; oversized grids fall back to the XLA lowering below.
     """
     assert x.ndim == 4, "expected NHWC"
+    if backend == "pallas":
+        from gansformer_tpu.ops.pallas_upfirdn import (upfirdn_fits,
+                                                       upfirdn2d_pallas)
+
+        f_np = np.asarray(f, np.float32)
+        if f_np.ndim == 1:
+            f_np = np.outer(f_np, f_np)
+        if upfirdn_fits(x.shape, f_np.shape, up, down, _pad4(pad)):
+            return upfirdn2d_pallas(x, f_np, up=up, down=down, pad=pad)
     f = jnp.asarray(f, dtype=x.dtype)
     assert f.ndim == 2
     pady0, pady1, padx0, padx1 = _pad4(pad)
@@ -101,27 +114,33 @@ def upfirdn2d(x: jax.Array, f, up: int = 1, down: int = 1,
     )
 
 
-def upsample_2d(x: jax.Array, f, factor: int = 2, gain: float = 1.0) -> jax.Array:
+def upsample_2d(x: jax.Array, f, factor: int = 2, gain: float = 1.0,
+                backend: str = "xla") -> jax.Array:
     """Upsample with FIR anti-imaging filter (reference: ``upsample_2d``)."""
     f = setup_filter(f, gain=gain * (factor**2))
     p = f.shape[0] - factor
     return upfirdn2d(x, f, up=factor,
-                     pad=((p + 1) // 2 + factor - 1, p // 2))
+                     pad=((p + 1) // 2 + factor - 1, p // 2),
+                     backend=backend)
 
 
-def downsample_2d(x: jax.Array, f, factor: int = 2, gain: float = 1.0) -> jax.Array:
+def downsample_2d(x: jax.Array, f, factor: int = 2, gain: float = 1.0,
+                  backend: str = "xla") -> jax.Array:
     """Blur-pool downsample (reference: ``downsample_2d``)."""
     f = setup_filter(f, gain=gain)
     p = f.shape[0] - factor
-    return upfirdn2d(x, f, down=factor, pad=((p + 1) // 2, p // 2))
+    return upfirdn2d(x, f, down=factor, pad=((p + 1) // 2, p // 2),
+                     backend=backend)
 
 
 def filter_2d(x: jax.Array, f, gain: float = 1.0,
-              extra_pad: Tuple[int, int] = (0, 0)) -> jax.Array:
+              extra_pad: Tuple[int, int] = (0, 0),
+              backend: str = "xla") -> jax.Array:
     """Same-resolution blur (reference: ``filter_2d``); ``extra_pad`` lets
     callers fold a following VALID conv's padding into the blur, the trick the
     reference's ``conv_downsample_2d`` / ``upsample_conv_2d`` use."""
     f = setup_filter(f, gain=gain)
     p = f.shape[0] - 1
     return upfirdn2d(x, f,
-                     pad=((p + 1) // 2 + extra_pad[0], p // 2 + extra_pad[1]))
+                     pad=((p + 1) // 2 + extra_pad[0], p // 2 + extra_pad[1]),
+                     backend=backend)

@@ -76,9 +76,13 @@ def backend_signature() -> Dict[str, Any]:
 def fingerprint(model_cfg_json: str, kind: str, bucket: int) -> str:
     """Content hash of everything that determines the compiled program:
     the model architecture (full ModelConfig JSON — resolution, dtype,
-    attention flavor, backend, …), the program kind, the batch bucket,
-    and the backend signature.  Two processes agree on the fingerprint
-    iff the serialized executable is valid for both."""
+    attention flavor, attention_backend AND conv_backend, …), the
+    program kind, the batch bucket, and the backend signature.  Two
+    processes agree on the fingerprint iff the serialized executable is
+    valid for both — in particular a manifest written under
+    ``conv_backend='pallas'`` can never warm-start an xla-conv service
+    (or vice versa): mixed-kernel executables are rejected as stale,
+    never silently served (ISSUE 14; pinned by tests/test_pallas_conv)."""
     payload = json.dumps({"model": json.loads(model_cfg_json),
                           "kind": kind, "bucket": bucket,
                           **backend_signature()}, sort_keys=True)

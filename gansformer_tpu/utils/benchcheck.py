@@ -79,13 +79,63 @@ BF16_PEAK_TFLOPS: List[Tuple[str, float]] = [
     ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
 ]
 
+# HBM bandwidth GB/s per chip by device_kind substring (public TPU
+# specs) — the memory roof of the roofline classification below.
+HBM_PEAK_GBPS: List[Tuple[str, float]] = [
+    ("v6e", 1640.0), ("v6 lite", 1640.0), ("v6", 1640.0),
+    ("v5e", 819.0), ("v5 lite", 819.0), ("v5litepod", 819.0),
+    ("v5p", 2765.0), ("v5", 2765.0),
+    ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0),
+]
 
-def peak_tflops(device_kind: str) -> Optional[float]:
+
+def _lookup(table: List[Tuple[str, float]],
+            device_kind: str) -> Optional[float]:
     dk = device_kind.lower()
-    for key, val in BF16_PEAK_TFLOPS:
+    for key, val in table:
         if key in dk:
             return val
     return None
+
+
+def peak_tflops(device_kind: str) -> Optional[float]:
+    return _lookup(BF16_PEAK_TFLOPS, device_kind)
+
+
+def peak_hbm_gbps(device_kind: str) -> Optional[float]:
+    return _lookup(HBM_PEAK_GBPS, device_kind)
+
+
+def roofline(flops: Optional[float], bytes_accessed: Optional[float],
+             peak_tflops_per_chip: Optional[float],
+             hbm_gbps: Optional[float],
+             ms: Optional[float] = None) -> dict:
+    """Roofline classification of one program from XLA cost analysis
+    (ISSUE 14 satellite): arithmetic intensity (FLOPs/byte) against the
+    machine-balance ridge point decides whether the compute or the
+    memory roof binds; with a measured ``ms``, ``pct_of_roof`` is the
+    achieved fraction of the BINDING ceiling — the attributable number
+    (a memory-bound op at 90% of its bandwidth roof is done; the same
+    MFU on a compute-bound op is the optimization target).
+
+    Pure and unit-tested (tests/test_benchcheck.py); returns {} when the
+    inputs can't support the classification.
+    """
+    if not flops or not bytes_accessed or not peak_tflops_per_chip \
+            or not hbm_gbps:
+        return {}
+    intensity = flops / bytes_accessed                     # FLOP/byte
+    ridge = peak_tflops_per_chip * 1e12 / (hbm_gbps * 1e9)
+    bound = "compute" if intensity >= ridge else "memory"
+    roof_flops_s = min(peak_tflops_per_chip * 1e12,
+                       intensity * hbm_gbps * 1e9)
+    out = {"intensity_flops_per_byte": round(intensity, 2),
+           "ridge_flops_per_byte": round(ridge, 2),
+           "bound": bound,
+           "roof_ms": round(flops / roof_flops_s * 1e3, 4)}
+    if ms:
+        out["pct_of_roof"] = round((flops / (ms * 1e-3)) / roof_flops_s, 4)
+    return out
 
 
 def cadence_weighted(vals: Dict[str, float], d_reg_interval: int,

@@ -89,6 +89,22 @@ def lever_catalog():
                 ("on", lambda c: _model_cfg(c, attn_fused_kv=True)),
             ],
         },
+        {
+            # ISSUE 14: the fused modulate→conv→demodulate / polyphase
+            # up-conv / upfirdn kernel family as a steppable lever — the
+            # 'on' variant compiles the REAL g step with
+            # conv_backend='pallas' (interpret mode off-TPU: structure
+            # only; a tunnel window prices the native ms delta).
+            "name": "conv_fused_mod",
+            "phase": "g",
+            "flag": "--conv-backend (ModelConfig.conv_backend)",
+            "test": "tests/test_levers.py::test_conv_fused_mod_parity",
+            "baseline": "off",
+            "variants": [
+                ("off", lambda c: _model_cfg(c, conv_backend="xla")),
+                ("on", lambda c: _model_cfg(c, conv_backend="pallas")),
+            ],
+        },
     ]
 
 

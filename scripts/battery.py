@@ -184,6 +184,15 @@ def default_stages():
         stage("pallas_train_ab", 1500, "pallas_train_ab_tpu.jsonl",
               [py, "scripts/bench_pallas_attention.py", "--train-ab",
                "--batch", "8"]),
+        # 8c. Conv-family kernel A/B (ISSUE 14): the same four-program
+        #    harness with conv_backend xla vs pallas — the modulated-
+        #    conv/upfirdn kernels (the 33%→51% MFU tier, ROADMAP 1)
+        #    priced on the REAL step programs with zero new plumbing.
+        #    Gated by the conv-family native smoke check inside the
+        #    script (skip-don't-crash; xla rows still land).
+        stage("modconv_train_ab", 1500, "modconv_train_ab_tpu.jsonl",
+              [py, "scripts/bench_pallas_attention.py", "--train-ab",
+               "--ab-backend", "conv", "--batch", "8"]),
         # 9. Real loop on the chip — now run UNDER the supervisor with
         #    one injected SIGKILL mid-checkpoint (ISSUE 12), so every
         #    tunnel window that trains also PROVES crash→resume recovery

@@ -40,6 +40,7 @@ import math
 import os
 import sys
 import time
+from types import SimpleNamespace
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -51,6 +52,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ASSUMED_MFU = 0.33
 # Projection peak when not on a TPU (PERF.md §1b: the v5e target chip).
 DEFAULT_PEAK_TFLOPS = 197.0
+# v5e HBM bandwidth for the off-TPU roofline projection (public spec).
+DEFAULT_HBM_GBPS = 819.0
 
 
 def expected_ms(flops: float, peak_tflops: float, mfu: float) -> float:
@@ -83,6 +86,11 @@ def build_attribution(components, step_flops, peak_tflops, assumed_mfu,
                "share_of_step": (
                    round(fl * 1e9 / step_flops, 4)
                    if fl and step_flops else None)}
+        rl = c.get("roofline") or {}
+        # The attributability fields (ISSUE 14 satellite): a kernel win
+        # is only a win against the roof that binds the op.
+        row["bound"] = rl.get("bound")
+        row["pct_of_roof"] = rl.get("pct_of_roof")
         rows.append(row)
     def key(r):
         if on_tpu and r["ms_measured"] is not None:
@@ -133,6 +141,13 @@ def main(argv=None) -> int:
                         "attn_einsums_* components (ISSUE 9): re-rank the "
                         "attribution table under the fused differentiable "
                         "kernels (off-TPU they run in interpret mode)")
+    p.add_argument("--conv-backend", default="both",
+                   choices=("xla", "pallas", "both"),
+                   help="modulated-conv/upfirdn components (ISSUE 14): "
+                        "'both' (default) times every pallas conv kernel "
+                        "(fwd + vjp) beside its XLA counterpart as "
+                        "*_pallas_* twins so kernel wins are directly "
+                        "attributable in one artifact")
     args = p.parse_args(argv)
 
     import jax
@@ -155,7 +170,8 @@ def main(argv=None) -> int:
         _conv, conv2d, modulated_conv2d)
     from gansformer_tpu.ops.upfirdn2d import downsample_2d, upsample_2d
     from gansformer_tpu.utils.benchcheck import (bytes_accessed_of, flops_of,
-                                                 peak_tflops)
+                                                 peak_hbm_gbps, peak_tflops,
+                                                 roofline)
 
     full_cfg = get_preset(args.preset)
     cfg = full_cfg.model
@@ -163,6 +179,21 @@ def main(argv=None) -> int:
     on_tpu = dev.platform == "tpu"
     peak = peak_tflops(dev.device_kind) if on_tpu else None
     proj_peak = peak or args.peak_tflops or DEFAULT_PEAK_TFLOPS
+    hbm = (peak_hbm_gbps(dev.device_kind) if on_tpu
+           else None) or DEFAULT_HBM_GBPS
+    # Which conv backends to emit components for; on TPU the pallas side
+    # is gated by the conv-family native smoke check (skip-don't-crash,
+    # same policy as resolve_conv_backend).
+    conv_backends = (("xla", "pallas") if args.conv_backend == "both"
+                     else (args.conv_backend,))
+    if "pallas" in conv_backends and on_tpu:
+        from gansformer_tpu.ops.pallas_modconv import tpu_smoke_check
+
+        ok, detail = tpu_smoke_check()
+        print(json.dumps({"name": "conv_tpu_smoke_check", "ok": ok,
+                          "detail": detail}), flush=True)
+        if not ok:
+            conv_backends = tuple(b for b in conv_backends if b != "pallas")
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     b = args.batch
     rs = np.random.RandomState(0)
@@ -172,8 +203,10 @@ def main(argv=None) -> int:
     meta = {"device_kind": dev.device_kind, "platform": dev.platform,
             "batch": b, "preset": args.preset, "peak_bf16_tflops": peak,
             "projection_peak_tflops": proj_peak,
+            "projection_hbm_gbps": hbm,
             "assumed_mfu": args.assumed_mfu,
-            "attention_backend": args.attention_backend}
+            "attention_backend": args.attention_backend,
+            "conv_backends": list(conv_backends)}
     print(json.dumps(meta), flush=True)
 
     def bytes_of(compiled):
@@ -207,51 +240,101 @@ def main(argv=None) -> int:
                 line["mfu"] = round(fl / (ms * 1e-3) / (peak * 1e12), 4)
         if by:
             line["gbytes"] = round(by / 1e9, 3)
+        # Roofline classification (ISSUE 14 satellite): memory- vs
+        # compute-bound from cost-analysis bytes/FLOPs, achieved % of
+        # the BINDING roof when a measured ms exists — the field that
+        # makes a kernel win attributable rather than just faster.
+        rl = roofline(fl, by, proj_peak, hbm, ms)
+        if rl:
+            line["roofline"] = rl
         line.update(extra_info)
         print(json.dumps(line), flush=True)
         components.append(line)
         return out
 
     # ---- leaf ops at each synthesis resolution ------------------------
+    # The modulated-conv/upfirdn family is emitted once per conv backend
+    # (ISSUE 14): the pallas kernels appear as *_pallas_* twins right
+    # beside their XLA counterparts (fwd AND vjp), so a kernel win in
+    # the artifact is attributable — same inputs, same cost model, only
+    # the lowering differs.  Off-TPU the pallas twins run in interpret
+    # mode (structure only, like every other CPU number here).
+    def conv_fns(backend):
+        if backend == "xla":
+            return SimpleNamespace(
+                modconv=lambda x, w, s, **kw: modulated_conv2d(x, w, s,
+                                                               **kw),
+                blur_up=lambda x: upsample_2d(x, (1, 3, 3, 1)),
+                blur_down=lambda x: downsample_2d(x, (1, 3, 3, 1)),
+                skip_down=lambda x, w: conv2d(x, w, down=2))
+        from gansformer_tpu.ops.pallas_modconv import modulated_conv2d_pallas
+        interp = not on_tpu
+        return SimpleNamespace(
+            modconv=lambda x, w, s, **kw: modulated_conv2d_pallas(
+                x, w, s, interpret=interp, **kw),
+            blur_up=lambda x: upsample_2d(x, (1, 3, 3, 1),
+                                          backend="pallas"),
+            blur_down=lambda x: downsample_2d(x, (1, 3, 3, 1),
+                                              backend="pallas"),
+            skip_down=lambda x, w: conv2d(x, w, down=2, backend="pallas"))
+
     for res in [r for r in (32, 64, 128, 256) if r <= cfg.resolution]:
         c = cfg.nf(res)
+        c_out = cfg.nf(res // 2)
         x = jnp.asarray(rs.randn(b, res, res, c), dtype)
         w3 = jnp.asarray(rs.randn(3, 3, c, c) * 0.05, dtype)
+        # ONE skip-weight draw per resolution: the xla/pallas twins and
+        # the decimated-vs-dense pair must all see the same weights for
+        # the attributability claim to hold.
+        w1 = jnp.asarray(rs.randn(1, 1, c, c_out) * 0.1, dtype)
         styles = jnp.asarray(rs.randn(b, c), jnp.float32)
-        timed(f"modconv3x3_{res}", lambda x, w, s: modulated_conv2d(x, w, s),
-              x, w3, styles, res=res, cin=c, cout=c)
-        timed(f"modconv3x3_up2_{res}",
-              lambda x, w, s: modulated_conv2d(x, w, s, up=2),
-              x, w3, styles, res=res, cin=c, cout=c)
-        if res * 2 in (cfg.resolution, cfg.resolution // 2):
-            # First-order backward of the up-conv feeding the 128²/256²
-            # grids — the grad-path share of the G time sink (ISSUE 5).
-            def upconv_loss(x, w, s):
-                y = modulated_conv2d(x, w, s, up=2)
-                return jnp.mean(jnp.square(y.astype(jnp.float32)))
+        want_vjp = res * 2 in (cfg.resolution, cfg.resolution // 2)
+        for backend in conv_backends:
+            fns = conv_fns(backend)
+            tag = "" if backend == "xla" else "pallas_"
+            timed(f"modconv3x3_{tag}{res}",
+                  lambda x, w, s: fns.modconv(x, w, s),
+                  x, w3, styles, res=res, cin=c, cout=c,
+                  conv_backend=backend)
+            timed(f"modconv3x3_up2_{tag}{res}",
+                  lambda x, w, s: fns.modconv(x, w, s, up=2),
+                  x, w3, styles, res=res, cin=c, cout=c,
+                  conv_backend=backend)
+            if want_vjp:
+                # First-order backward of the up-conv feeding the
+                # 128²/256² grids — the grad-path share of the G time
+                # sink (ISSUE 5); for pallas this drives the hand-written
+                # backward kernels (ISSUE 14's scoreboard pair).
+                def upconv_loss(x, w, s):
+                    y = fns.modconv(x, w, s, up=2)
+                    return jnp.mean(jnp.square(y.astype(jnp.float32)))
 
-            timed(f"modconv3x3_up2_vjp_{res}",
-                  lambda x, w, s: jax.grad(upconv_loss, argnums=(0, 1, 2))(
-                      x, w, s),
-                  x, w3, styles, res=res, cin=c, cout=c)
+                timed(f"modconv3x3_up2_vjp_{tag}{res}",
+                      lambda x, w, s: jax.grad(
+                          upconv_loss, argnums=(0, 1, 2))(x, w, s),
+                      x, w3, styles, res=res, cin=c, cout=c,
+                      conv_backend=backend)
+            timed(f"blur_up2_{tag}{res}", fns.blur_up,
+                  x, res=res, chans=c, conv_backend=backend)
+            timed(f"blur_down2_{tag}{res}", fns.blur_down,
+                  x, res=res, chans=c, conv_backend=backend)
+            if want_vjp:
+                def blur_loss(x):
+                    y = fns.blur_up(x)
+                    return jnp.mean(jnp.square(y.astype(jnp.float32)))
+
+                timed(f"blur_up2_vjp_{tag}{res}",
+                      lambda x: jax.grad(blur_loss)(x),
+                      x, res=res, chans=c, conv_backend=backend)
+            # D-skip 1x1 down-conv: decimated blur (PERF.md §1b'''').
+            timed(f"skip_down_decimated_{tag}{res}", fns.skip_down,
+                  x, w1, res=res, cin=c, cout=c_out, conv_backend=backend)
         # The pre-polyphase dense-at-2H formulation, timed for the on-chip
-        # before/after comparison (PERF.md §1b''').
+        # before/after comparison (PERF.md §1b''') — xla-only study.
         timed(f"upconv_dense_{res}",
               lambda x, w: _conv(upsample_2d(x, (1, 3, 3, 1)), w,
                                  stride=1, padding="SAME"),
               x, w3, res=res, cin=c, cout=c)
-        timed(f"blur_up2_{res}", lambda x: upsample_2d(x, (1, 3, 3, 1)),
-              x, res=res, chans=c)
-        timed(f"blur_down2_{res}", lambda x: downsample_2d(x, (1, 3, 3, 1)),
-              x, res=res, chans=c)
-        # D-skip 1x1 down-conv: decimated blur (current, PERF.md §1b'''')
-        # vs the dense formulation it replaced (blur every pixel, discard
-        # 3 of 4 in the strided conv) — the on-chip before/after.
-        c_out = cfg.nf(res // 2)
-        w1 = jnp.asarray(rs.randn(1, 1, c, c_out) * 0.1, dtype)
-        timed(f"skip_down_decimated_{res}",
-              lambda x, w: conv2d(x, w, down=2),
-              x, w1, res=res, cin=c, cout=c_out)
 
         def skip_dense(x, w):
             from gansformer_tpu.ops.upfirdn2d import setup_filter, upfirdn2d

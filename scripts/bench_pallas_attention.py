@@ -165,11 +165,13 @@ def bench_one(res: int, k: int, batch: int, heads: int, iters: int,
 
 
 def train_ab(preset: str, batch: int, iters: int,
-             pallas_ok: bool = True) -> None:
+             pallas_ok: bool = True,
+             field: str = "attention_backend") -> None:
     """The training-path A/B: cost-analysis (and, on TPU, steady-state
-    time) of the four REAL step programs per attention backend — the
-    attention-bearing step programs' byte evidence, one JSON line per
-    phase (battery stage ``pallas_train_ab``).
+    time) of the four REAL step programs per backend of ``field`` —
+    ``attention_backend`` (ISSUE 9, battery stage ``pallas_train_ab``)
+    or ``conv_backend`` (ISSUE 14, battery stage ``modconv_train_ab``):
+    one JSON line per phase.
 
     Capture beats verdict: one line is FLUSHED per phase as soon as its
     backends are measured, a failed smoke check skips the pallas side
@@ -192,7 +194,7 @@ def train_ab(preset: str, batch: int, iters: int,
 
     def measure(backend, phase):
         cfg = dataclasses.replace(base, model=dataclasses.replace(
-            base.model, attention_backend=backend))
+            base.model, **{field: backend}))
         compiled = lower_phase(cfg, phase, batch_size=batch)
         rec = {**cost_summary(compiled),
                "temp_gbytes": temp_workspace_gbytes(compiled)}
@@ -227,6 +229,7 @@ def train_ab(preset: str, batch: int, iters: int,
 
     for phase in ("d", "g", "d_r1", "g_pl"):
         line = {"name": f"train_ab_{phase}", "preset": preset,
+                "field": field,
                 "batch": batch, "platform": jax.default_backend()}
         for backend in backends:
             try:
@@ -260,8 +263,13 @@ def main() -> None:
     p.add_argument("--heads", type=int, default=1)
     p.add_argument("--train-ab", action="store_true",
                    help="A/B the four REAL step programs (xla vs pallas "
-                        "attention backend): cost-analysis bytes/FLOPs/"
-                        "temp workspace, plus steady-state ms on TPU")
+                        "backend): cost-analysis bytes/FLOPs/temp "
+                        "workspace, plus steady-state ms on TPU")
+    p.add_argument("--ab-backend", default="attention",
+                   choices=("attention", "conv"),
+                   help="which backend field --train-ab flips: the "
+                        "bipartite-attention kernels (ISSUE 9) or the "
+                        "modulated-conv/upfirdn kernel family (ISSUE 14)")
     p.add_argument("--preset", default="ffhq256-duplex")
     args = p.parse_args()
 
@@ -280,10 +288,19 @@ def main() -> None:
     head = {"device_kind": dev.device_kind, "platform": dev.platform}
     pallas_ok = True
     if dev.platform == "tpu":
-        from gansformer_tpu.ops.pallas_attention import tpu_smoke_check
+        # The gate matching the family under test: the conv A/B must not
+        # be skipped because an unrelated attention kernel regressed
+        # (and vice versa).
+        if args.train_ab and args.ab_backend == "conv":
+            from gansformer_tpu.ops.pallas_modconv import tpu_smoke_check
+        else:
+            from gansformer_tpu.ops.pallas_attention import tpu_smoke_check
 
         ok, detail = tpu_smoke_check()
-        head["tpu_smoke_check"] = {"ok": ok, "detail": detail}
+        head["tpu_smoke_check"] = {"ok": ok, "detail": detail,
+                                   "family": (args.ab_backend
+                                              if args.train_ab
+                                              else "attention")}
         # A failed native compile must not abort the sweep: the xla
         # timings (and the failure record above) are still the artifact —
         # the same skip-don't-crash policy as ops resolve_backend.
@@ -296,7 +313,8 @@ def main() -> None:
 
     if args.train_ab:
         train_ab(args.preset, args.batch, min(args.iters, 10),
-                 pallas_ok=pallas_ok)
+                 pallas_ok=pallas_ok,
+                 field=f"{args.ab_backend}_backend")
         return
 
     for res in args.res:

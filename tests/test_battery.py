@@ -362,3 +362,17 @@ def test_side_artifact_copies_survive_stage_failure(tmp_path):
             open(src, "w").write(backup)
         elif os.path.exists(src):
             os.remove(src)
+
+
+def test_modconv_train_ab_stage_wired():
+    """ISSUE 14 satellite: the conv-backend four-program A/B rides the
+    battery with zero new plumbing — same script as the attention A/B,
+    flipped to the conv field, landing its own window artifact."""
+    stages = {s["name"]: s for s in battery.default_stages()}
+    st = stages["modconv_train_ab"]
+    argv = " ".join(st["argv"])
+    assert "bench_pallas_attention.py" in argv
+    assert "--train-ab" in argv
+    assert "--ab-backend conv" in argv or "--ab-backend', 'conv" in argv \
+        or ("--ab-backend" in st["argv"] and "conv" in st["argv"])
+    assert st["artifact"] == "modconv_train_ab_tpu.jsonl"

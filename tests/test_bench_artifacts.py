@@ -246,6 +246,22 @@ def test_bench_components_end_to_end_cpu(tmp_path):
     assert any(n.startswith("attn_block_") for n in names)
     assert any(n.startswith("attn_einsums_") for n in names)
     assert any(n.startswith("modconv3x3_up2_vjp_") for n in names)
+    # ISSUE 14: every conv kernel is timed beside its XLA counterpart —
+    # the *_pallas_* twins (fwd AND vjp) land in the same artifact ...
+    assert any(n.startswith("modconv3x3_pallas_") for n in names)
+    assert any(n.startswith("modconv3x3_up2_pallas_") for n in names)
+    assert any(n.startswith("modconv3x3_up2_vjp_pallas_") for n in names)
+    assert any(n.startswith("blur_up2_pallas_") for n in names)
+    # ... and the roofline classification rides every cost-bearing row
+    # (memory- vs compute-bound + the binding roof), including into the
+    # ranked attribution table.
+    with_cost = [c for c in art["components"]
+                 if c.get("gflops") and c.get("gbytes")]
+    assert with_cost
+    for c in with_cost:
+        assert c["roofline"]["bound"] in ("memory", "compute")
+        assert c["roofline"]["roof_ms"] > 0
+    assert any(r.get("bound") for r in art["attribution"])
     # phase denominator + ranked shares
     assert set(art["phase_gflops"]) == {"d", "g", "d_r1", "g_pl"}
     assert art["step_gflops_per_iteration"] > 0

@@ -104,3 +104,51 @@ def test_flops_of_compiled_and_garbage():
 
     assert flops_of(Garbage()) is None
     assert flops_of(object()) is None
+
+
+# --- roofline classification (ISSUE 14 satellite) -----------------------
+
+def test_roofline_classifies_memory_vs_compute_bound():
+    from gansformer_tpu.utils.benchcheck import roofline
+
+    # v5e-ish machine: 197 TFLOP/s, 819 GB/s → ridge ≈ 240.5 FLOP/byte.
+    # A 4-tap depthwise blur (~0.1 FLOP/byte) is memory-bound; a dense
+    # 512² matmul chain (~1000 FLOP/byte) is compute-bound.
+    mem = roofline(flops=1e9, bytes_accessed=1e10,
+                   peak_tflops_per_chip=197.0, hbm_gbps=819.0)
+    assert mem["bound"] == "memory"
+    assert mem["intensity_flops_per_byte"] == pytest.approx(0.1)
+    assert mem["ridge_flops_per_byte"] == pytest.approx(240.54, rel=1e-3)
+    comp = roofline(flops=1e12, bytes_accessed=1e9,
+                    peak_tflops_per_chip=197.0, hbm_gbps=819.0)
+    assert comp["bound"] == "compute"
+
+
+def test_roofline_pct_of_binding_roof():
+    from gansformer_tpu.utils.benchcheck import roofline
+
+    # memory-bound op: roof = intensity * BW = 0.1 * 819e9 = 81.9 GFLOP/s
+    # → 1 GFLOP takes 12.21 ms at the roof; measured 24.42 ms = 50%.
+    r = roofline(flops=1e9, bytes_accessed=1e10,
+                 peak_tflops_per_chip=197.0, hbm_gbps=819.0,
+                 ms=2 * 1e9 / 81.9e9 * 1e3)
+    assert r["pct_of_roof"] == pytest.approx(0.5, rel=1e-3)
+    assert r["roof_ms"] == pytest.approx(1e9 / 81.9e9 * 1e3, rel=1e-3)
+    # compute-bound op at exactly peak = 1.0
+    r2 = roofline(flops=1e12, bytes_accessed=1e9,
+                  peak_tflops_per_chip=197.0, hbm_gbps=819.0,
+                  ms=1e12 / 197e12 * 1e3)
+    assert r2["pct_of_roof"] == pytest.approx(1.0, rel=1e-3)
+
+
+def test_roofline_degrades_to_empty_without_inputs():
+    from gansformer_tpu.utils.benchcheck import (peak_hbm_gbps, roofline)
+
+    assert roofline(None, 1e9, 197.0, 819.0) == {}
+    assert roofline(1e9, None, 197.0, 819.0) == {}
+    assert roofline(1e9, 1e9, None, 819.0) == {}
+    assert roofline(1e9, 1e9, 197.0, None) == {}
+    # the HBM lookup mirrors peak_tflops' substring discipline
+    assert peak_hbm_gbps("TPU v5e chip") == 819.0
+    assert peak_hbm_gbps("TPU v5p") == 2765.0
+    assert peak_hbm_gbps("Quantum QPU") is None

@@ -276,7 +276,7 @@ def test_ab_levers_catalog_covers_the_wired_levers():
     ab = _load_script("ab_levers")
     catalog = {lv["name"]: lv for lv in ab.lever_catalog()}
     assert set(catalog) == {"pl_batch_shrink", "r1_batch_shrink",
-                            "attn_fused_kv"}
+                            "attn_fused_kv", "conv_fused_mod"}
     for lv in catalog.values():
         settings = [s for s, _ in lv["variants"]]
         assert lv["baseline"] in settings
@@ -288,6 +288,37 @@ def test_ab_levers_catalog_covers_the_wired_levers():
         cfg).train.pl_batch_shrink == 4
     assert catalog["attn_fused_kv"]["variants"][1][1](
         cfg).model.attn_fused_kv is True
+    assert catalog["conv_fused_mod"]["variants"][1][1](
+        cfg).model.conv_backend == "pallas"
+
+
+def test_conv_fused_mod_parity():
+    """Acceptance anchor of the conv_fused_mod lever (ISSUE 14): the
+    'on' variant is the SAME math — generator outputs agree across
+    conv backends on identical params (the deep parity battery lives in
+    tests/test_pallas_conv.py; this pins the lever's config contract +
+    that the flipped config validates and changes only the backend)."""
+    ab = _load_script("ab_levers")
+    catalog = {lv["name"]: lv for lv in ab.lever_catalog()}
+    on = catalog["conv_fused_mod"]["variants"][1][1](micro_cfg())
+    off = catalog["conv_fused_mod"]["variants"][0][1](micro_cfg())
+    on.validate(), off.validate()
+    assert on.model.conv_backend == "pallas"
+    assert dataclasses.replace(on.model, conv_backend="xla") == off.model
+
+    from gansformer_tpu.models.generator import Generator
+
+    rng = np.random.RandomState(0)
+    z = jnp.asarray(rng.randn(2, on.model.num_ws, on.model.latent_dim),
+                    jnp.float32)
+    noise = jax.random.PRNGKey(3)
+    G_off = Generator(off.model)
+    params = G_off.init({"params": jax.random.PRNGKey(0), "noise": noise},
+                        z)
+    out_off = G_off.apply(params, z, rngs={"noise": noise})
+    out_on = Generator(on.model).apply(params, z, rngs={"noise": noise})
+    np.testing.assert_allclose(np.asarray(out_on), np.asarray(out_off),
+                               atol=1e-4, rtol=1e-4)
 
 
 def test_ab_levers_delta_attachment_pure():

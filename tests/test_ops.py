@@ -68,6 +68,58 @@ def test_upfirdn2d_grad(rng):
     jtu.check_grads(fn, (x,), order=2, modes=("rev",), atol=1e-2, rtol=1e-2)
 
 
+# ISSUE 14 satellite: the wrappers' GRADIENTS against the closed-form
+# adjoint (upfirdn is linear, so grad-of-⟨r, y⟩ must equal the oracle
+# upfirdn of r with the flipped filter, up↔down swapped, and the
+# reference's gradient pads) — odd AND even taps, asymmetric pads.
+# Previously only forward shapes were exercised.
+@pytest.mark.parametrize("taps", [[1, 3, 3, 1], [1, 2, 1]],
+                         ids=["even4", "odd3"])
+@pytest.mark.parametrize("wrapper", ["upsample_2d", "downsample_2d",
+                                     "filter_2d", "asym"])
+def test_upfirdn_wrapper_grads_match_adjoint_oracle(rng, taps, wrapper):
+    x = rng.randn(2, 7, 9, 3).astype(np.float32)
+    f = refs.setup_filter_ref(taps)
+    fh = f.shape[0]
+    if wrapper == "upsample_2d":
+        fn = lambda v: ops.upsample_2d(v, taps)
+        up, down = 2, 1
+        p = fh - 2
+        pad = ((p + 1) // 2 + 1, p // 2, (p + 1) // 2 + 1, p // 2)
+        f_eff = f * 4.0                       # gain = factor²
+    elif wrapper == "downsample_2d":
+        fn = lambda v: ops.downsample_2d(v, taps)
+        up, down = 1, 2
+        p = fh - 2
+        pad = ((p + 1) // 2, p // 2, (p + 1) // 2, p // 2)
+        f_eff = f
+    elif wrapper == "filter_2d":
+        fn = lambda v: ops.filter_2d(v, taps)
+        up, down = 1, 1
+        p = fh - 1
+        pad = ((p + 1) // 2, p // 2, (p + 1) // 2, p // 2)
+        f_eff = f
+    else:                                     # raw op, asymmetric pads
+        pad = (2, 0, 1, 3)
+        up, down = 2, 2
+        f_eff = f
+        fn = lambda v: ops.upfirdn2d(
+            v, jnp.asarray(f, jnp.float32), up=up, down=down, pad=pad)
+    y = fn(jnp.asarray(x))
+    r = rng.randn(*y.shape).astype(np.float32)
+    got = jax.grad(lambda v: jnp.sum(fn(v) * jnp.asarray(r)))(
+        jnp.asarray(x))
+    # adjoint: flipped filter, up/down swapped, reference gradient pads
+    oh = y.shape[1]
+    ow = y.shape[2]
+    gpad = (fh - pad[0] - 1, x.shape[1] * up - oh * down + pad[0] - up + 1,
+            fh - pad[2] - 1, x.shape[2] * up - ow * down + pad[2] - up + 1)
+    want = refs.upfirdn2d_ref(r.astype(np.float64), f_eff[::-1, ::-1],
+                              up=down, down=up, pad=gpad)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+
+
 # ------------------------------------------------------------ fused_bias_act
 
 @pytest.mark.parametrize("act", ["linear", "relu", "lrelu", "tanh", "sigmoid"])
