@@ -118,6 +118,12 @@ def check_metric_families(path: str) -> List[str]:
     * ``compile/*`` — ``compile_compiles_total`` (materialized at
       listener install) and ``compile_retraces_total`` (materialized at
       the tick-0 arm).
+    * ``data/*`` robustness family (ISSUE 15) — the retry/quarantine/
+      stall counters, materialized by the loop at setup so absence
+      always means rotted wiring.  Values-aware: quarantines > 0 imply
+      the ``data_quarantine.jsonl`` ledger exists beside the prom (a
+      counter that moved without its offset+cause evidence is
+      unreviewable).
     """
     from gansformer_tpu.obs.registry import parse_prom_values
 
@@ -147,6 +153,21 @@ def check_metric_families(path: str) -> List[str]:
     for name in ("compile_compiles_total", "compile_retraces_total"):
         if name not in vals:
             errors.append(f"{path}: missing {name}")
+    for name in ("data_read_retries_total", "data_corrupt_records_total",
+                 "data_stalls_total"):
+        if name not in vals:
+            errors.append(f"{path}: missing data/* robustness family "
+                          f"member {name} (is the ISSUE-15 data plane "
+                          f"wired?)")
+    if vals.get("data_corrupt_records_total", 0.0) > 0:
+        ledger = os.path.join(os.path.dirname(os.path.abspath(path)),
+                              "data_quarantine.jsonl")
+        if not os.path.exists(ledger):
+            errors.append(
+                f"{path}: data_corrupt_records_total = "
+                f"{vals['data_corrupt_records_total']:g} but no "
+                f"data_quarantine.jsonl ledger beside it — quarantines "
+                f"without offset+cause evidence are unreviewable")
     return errors
 
 
@@ -226,6 +247,8 @@ def check_supervise_metric_families(path: str) -> List[str]:
     members = ("supervise_restarts_total", "supervise_exits_total",
                "supervise_clean_exits_total", "supervise_crashes_total",
                "supervise_preemptions_total", "supervise_hangs_total",
+               "supervise_data_corrupt_exits_total",
+               "supervise_data_stall_exits_total",
                "supervise_availability_ratio",
                "supervise_uptime_s_total", "supervise_downtime_s_total",
                "supervise_restart_budget_remaining")
@@ -236,7 +259,9 @@ def check_supervise_metric_families(path: str) -> List[str]:
     total = vals.get("supervise_exits_total")
     by_cause = [vals.get(f"supervise_{c}", 0.0)
                 for c in ("clean_exits_total", "crashes_total",
-                          "preemptions_total", "hangs_total")]
+                          "preemptions_total", "hangs_total",
+                          "data_corrupt_exits_total",
+                          "data_stall_exits_total")]
     if total is not None and sum(by_cause) != total:
         errors.append(f"{path}: per-cause exit counters sum to "
                       f"{sum(by_cause):g} but supervise_exits_total is "

@@ -316,6 +316,65 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
     else:
         check("queues", "PASS", f"no starvation; {qdetail}")
 
+    # -- data-plane robustness (ISSUE 15) -----------------------------------
+    # Graded only when the data/* robustness family is present (run dirs
+    # predating the fault-tolerant data plane just skip the section):
+    # FAIL on a stall-kill or a corrupt-frac budget breach, WARN on any
+    # quarantines/retries (the run survived, a human should know), PASS
+    # on clean counters.
+    # Counters reset per PROCESS (a resumed run starts a fresh
+    # registry), so the last tick's snapshot under-reports anything that
+    # happened before a restart — e.g. a read retry absorbed just
+    # before a crash.  The stats.jsonl records are append-only across
+    # restarts: take the max over every tick's snapshot, falling back
+    # to the live accessor for dirs that died before a tick landed.
+    _data_records = read_stats_records(run_dir)   # one read for all three
+
+    def _max_counter(name):
+        seen = [r["telemetry"]["counters"][name]
+                for r in _data_records
+                if name in r.get("telemetry", {}).get("counters", {})]
+        live = tele.counter(name)
+        if live is not None:
+            seen.append(live)
+        return max(seen) if seen else None
+
+    d_corrupt = _max_counter("data/corrupt_records_total")
+    d_retries = _max_counter("data/read_retries_total")
+    d_stalls = _max_counter("data/stalls_total")
+    d_frac = tele.gauge("data/corrupt_frac")
+    d_budget = tele.gauge("data/corrupt_budget_frac")
+    if any(v is not None for v in (d_corrupt, d_retries, d_stalls)):
+        n_ledger = 0
+        ledger = os.path.join(run_dir, "data_quarantine.jsonl")
+        if os.path.exists(ledger):
+            with open(ledger) as f:
+                n_ledger = sum(1 for line in f if line.strip())
+        dbits = ("{} quarantined record(s) ({} ledger line(s)), {} read "
+                 "retr{}, corrupt frac {}".format(
+                     int(d_corrupt or 0), n_ledger, int(d_retries or 0),
+                     "y" if int(d_retries or 0) == 1 else "ies",
+                     "?" if d_frac is None else f"{d_frac:.2%}"
+                     + ("" if d_budget is None
+                        else f" of {d_budget:.2%} budget")))
+        if d_stalls:
+            check("data_plane", "FAIL",
+                  f"data stall watchdog fired {int(d_stalls)} time(s) — "
+                  f"the input pipeline wedged (DataStalled); {dbits}")
+        elif d_frac is not None and d_budget is not None and \
+                d_frac > d_budget:
+            check("data_plane", "FAIL",
+                  f"corrupt-record fraction {d_frac:.2%} exceeds the "
+                  f"{d_budget:.2%} budget — the run exits typed "
+                  f"data-corrupt (static defect; fix the dataset, not "
+                  f"the restart count); {dbits}")
+        elif (d_corrupt or 0) > 0 or (d_retries or 0) > 0:
+            check("data_plane", "WARN",
+                  f"data plane degraded but within budget — {dbits}")
+        else:
+            check("data_plane", "PASS",
+                  f"no quarantines, retries, or stalls; {dbits}")
+
     # -- compiles / retraces ------------------------------------------------
     compiles = tele.counter("compile/compiles_total")
     retraces = tele.counter("compile/retraces_total")
@@ -411,9 +470,14 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
                            sorted(s["causes"].items())) or "none"
         summary = (f"{s['restarts']} restart(s), exits: {causes}{ratio}")
         if s["gave_up"]:
+            nr = sorted(set(s["causes"])
+                        & set(sup_events.NON_RETRYABLE_CAUSES))
             check("availability", "FAIL",
-                  f"supervisor GAVE UP (restart budget exhausted) — "
-                  f"{summary}; the run needs a human")
+                  (f"supervisor gave up on non-retryable cause(s) "
+                   f"{', '.join(nr)} (static defect — fix the dataset, "
+                   f"not the restart count) — {summary}" if nr else
+                   f"supervisor GAVE UP (restart budget exhausted) — "
+                   f"{summary}; the run needs a human"))
         elif s["unclassified"]:
             check("availability", "WARN",
                   f"unclassified exit cause(s) {s['unclassified']} in "

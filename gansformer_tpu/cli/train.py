@@ -155,6 +155,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-source",
                    choices=["synthetic", "npz", "tfrecord", "folder"])
     p.add_argument("--mirror-augment", action="store_true")
+    # Data-plane fault tolerance (ISSUE 15, docs/data.md): the corruption
+    # budget, the transient-read retry count, and the producer-progress
+    # stall watchdog.  Past the budget the run exits typed
+    # (EXIT_DATA_CORRUPT) and the supervisor gives up instead of
+    # crash-looping on a static defect.
+    p.add_argument("--max-corrupt-frac", type=float, default=None,
+                   help="quarantined/total record fraction above which "
+                        "the run fails typed as data-corrupt "
+                        "(non-retryable; default 0.01)")
+    p.add_argument("--io-retries", type=int, default=None,
+                   help="bounded-backoff retries for transient record "
+                        "read errors (default 3)")
+    p.add_argument("--stall-after-s", type=float, default=None,
+                   help="data-stall watchdog: seconds of zero producer "
+                        "progress before the loop fails typed as "
+                        "data-stalled (0 = off; default 120)")
     # mesh / multi-host (replaces reference --num-gpus)
     p.add_argument("--mesh-data", type=int, default=None,
                    help="data-axis size; -1 = all devices "
@@ -237,7 +253,10 @@ def config_from_args(args) -> ExperimentConfig:
     if args.profile_dir:
         train = dataclasses.replace(train, profile_dir=args.profile_dir)
     data = override(cfg.data, path=args.data_path, source=args.data_source,
-                    resolution=args.resolution)
+                    resolution=args.resolution,
+                    max_corrupt_frac=getattr(args, "max_corrupt_frac", None),
+                    io_retries=getattr(args, "io_retries", None),
+                    stall_after_s=getattr(args, "stall_after_s", None))
     if args.mirror_augment:
         data = dataclasses.replace(data, mirror_augment=True)
     dp = getattr(args, "device_prefetch", None)
@@ -435,8 +454,10 @@ def main(argv=None) -> None:
                 f"--selfcheck: {n_new} new graftlint finding(s); see "
                 f"{os.path.join(run_dir, 'graftlint.json')} — fix, "
                 f"suppress with a justification, or baseline, then rerun")
+    from gansformer_tpu.data.errors import DataCorrupt, DataStalled
     from gansformer_tpu.supervise.events import (
-        EXIT_PREEMPTED, PreemptionExit)
+        EXIT_DATA_CORRUPT, EXIT_DATA_STALLED, EXIT_PREEMPTED,
+        PreemptionExit)
 
     try:
         train(cfg, run_dir, resume=args.resume, logger=logger)
@@ -447,6 +468,20 @@ def main(argv=None) -> None:
         logger.write(f"preempted cleanly at step {e.step}; "
                      f"exit code {EXIT_PREEMPTED}")
         raise SystemExit(EXIT_PREEMPTED)
+    except DataCorrupt as e:
+        # Corruption budget exhausted — a STATIC data defect.  The
+        # distinct exit code makes the supervisor classify this as
+        # non-retryable (cause 'data-corrupt') and give up instead of
+        # burning its restart budget on a crash loop (ISSUE 15).
+        logger.write(f"data corrupt (budget exhausted): {e}; "
+                     f"exit code {EXIT_DATA_CORRUPT}")
+        raise SystemExit(EXIT_DATA_CORRUPT)
+    except DataStalled as e:
+        # Input pipeline stalled past its watchdog — classified and fast
+        # (well inside the supervisor's heartbeat-staleness SIGKILL);
+        # possibly transient, so the supervisor still retries it.
+        logger.write(f"data stalled: {e}; exit code {EXIT_DATA_STALLED}")
+        raise SystemExit(EXIT_DATA_STALLED)
 
 
 if __name__ == "__main__":

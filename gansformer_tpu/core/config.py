@@ -264,6 +264,24 @@ class DataConfig:
     device_prefetch: bool = True
     device_prefetch_depth: int = 2   # HBM ring size, in batches
     mirror_augment: bool = False
+    # --- fault tolerance (ISSUE 15, docs/data.md) ---------------------------
+    # Corruption budget: corrupt TFRecord records are QUARANTINED (ledger
+    # + data/corrupt_records_total) and the run keeps streaming; it fails
+    # typed (DataCorrupt → exit EXIT_DATA_CORRUPT, supervisor cause
+    # 'data-corrupt', non-retryable) only once quarantined/total exceeds
+    # this fraction — a static defect must not burn the restart budget.
+    max_corrupt_frac: float = 0.01
+    # Transient read errors (network filesystems) retry this many times
+    # under exponential backoff before surfacing as a crash.
+    io_retries: int = 3
+    io_retry_base_s: float = 0.05
+    # Producer-progress stall watchdog on the prefetch layers: a consumer
+    # blocked this long with NO producer progress raises typed
+    # DataStalled (exit EXIT_DATA_STALLED, supervisor cause 'data-stall')
+    # — a fast classified data-hang signal well inside the supervisor's
+    # 300 s heartbeat-staleness SIGKILL.  Must exceed the worst-case
+    # single-batch decode; 0 disables the watchdog.
+    stall_after_s: float = 120.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -402,6 +420,18 @@ class ExperimentConfig:
         if self.data.device_prefetch and self.data.device_prefetch_depth < 1:
             errs.append(f"data.device_prefetch_depth must be ≥ 1, got "
                         f"{self.data.device_prefetch_depth}")
+        if not 0.0 <= self.data.max_corrupt_frac <= 1.0:
+            errs.append(f"data.max_corrupt_frac must be in [0, 1], got "
+                        f"{self.data.max_corrupt_frac}")
+        if self.data.io_retries < 0:
+            errs.append(f"data.io_retries must be ≥ 0, got "
+                        f"{self.data.io_retries}")
+        if self.data.io_retry_base_s <= 0:
+            errs.append(f"data.io_retry_base_s must be > 0, got "
+                        f"{self.data.io_retry_base_s}")
+        if self.data.stall_after_s < 0:
+            errs.append(f"data.stall_after_s must be ≥ 0 (0 = watchdog "
+                        f"off), got {self.data.stall_after_s}")
         if m.mbstd_group_size > 1 and t.batch_size % m.mbstd_group_size:
             # minibatch_stddev would silently shrink the group; surface the
             # mismatch instead so the trained config means what it says.

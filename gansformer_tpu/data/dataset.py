@@ -16,8 +16,13 @@ labels; SURVEY.md §2.2/§3.4).  Re-designed for the JAX/TPU input model:
   (``<name>-r{lod}.tfrecords``, features: shape [3] int64 + data bytes,
   CHW uint8) so datasets prepared for the reference work unchanged — via a
   hand-rolled TFRecord framing + protobuf walk, so the framework has NO
-  TensorFlow dependency.  Malformed records raise (loud corruption beats a
-  silently shrinking dataset).
+  TensorFlow dependency.  Since ISSUE 15 the source is **index-addressed**
+  and fault-tolerant: the full matched-resolution shard set is read (not
+  one file), a per-file record-offset index sidecar makes every record
+  seekable (``start_batch`` resume advances the RNG stream only — the
+  strict tick-parity contract now covers TFRecords), corrupt records are
+  *quarantined* under a budget instead of killing the run, and transient
+  read errors retry under bounded backoff (docs/data.md).
 """
 
 from __future__ import annotations
@@ -25,14 +30,17 @@ from __future__ import annotations
 import glob
 import os
 import queue
+import re
 import struct
 import threading
 import time
-from typing import Iterator, Optional, Sequence, Tuple
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from gansformer_tpu.data.errors import DataCorrupt, stall_guarded_get
 from gansformer_tpu.obs import registry as telemetry
+from gansformer_tpu.supervise import faults
 
 
 class Dataset:
@@ -52,10 +60,22 @@ class Dataset:
         at batch index N of the seed-determined sequence — the resume
         contract: a run restored at iteration N consumes the same
         batches an uninterrupted run would, so loss trajectories stay
-        tick-for-tick comparable across restarts.  Index-addressed
-        sources fast-forward by advancing the RNG stream only (no image
-        decode); sequential sources (TFRecord) document best-effort."""
+        tick-for-tick IDENTICAL across restarts.  Every source is
+        index-addressed (synthetic/npz/folder/tfrecord since ISSUE 15's
+        record-offset sidecar), so the fast-forward advances the RNG
+        stream only — no image decode, no best-effort carve-outs."""
         raise NotImplementedError
+
+    def set_quarantine_ledger(self, path: str) -> None:
+        """Point the source's corruption-quarantine ledger at
+        ``<run_dir>/data_quarantine.jsonl`` (the train loop wires this).
+        Sources without a quarantine path (synthetic/npz/folder decode
+        from trusted memory) ignore it."""
+
+    def close(self) -> None:
+        """Release OS resources (cached record fds).  Idempotent; a
+        no-op for in-memory sources.  The train loop's finally calls
+        it after the prefetch layers have joined."""
 
     def random_labels(self, n: int, seed: int = 0) -> Optional[np.ndarray]:
         """n labels drawn from the dataset's label distribution (reference
@@ -152,9 +172,15 @@ class NpzDataset(Dataset):
 
 
 _SCAN_CHUNK = 64 * 1024 * 1024
-# Files whose checksums verified on a complete pass — corruption is a
-# static property, so epochs 2+ skip the CRC work (~90 ms/GB).
+# Files whose checksums verified on a complete pass — keyed by
+# (path, mtime_ns, size) so an overwritten/regenerated file is
+# re-verified instead of inheriting a stale verdict (ISSUE 15 satellite).
 _CRC_VERIFIED: set = set()
+
+
+def _file_sig(path: str) -> Tuple[int, int]:
+    st = os.stat(path)
+    return int(st.st_mtime_ns), int(st.st_size)
 
 
 def _iter_tfrecord_raw(path: str) -> Iterator[bytes]:
@@ -172,11 +198,13 @@ def _iter_tfrecord_raw(path: str) -> Iterator[bytes]:
     """
     from gansformer_tpu import native
 
-    if native.get_lib() is not None and path not in _CRC_VERIFIED:
-        # First pass over a file: native chunked scan WITH checksums, so a
-        # corrupt dataset fails loudly up front.  Later passes use the
-        # lighter per-record framing below (still native proto parse),
-        # which measures ~2× faster in steady state.
+    sig = (path, *_file_sig(path))
+    if native.get_lib() is not None and sig not in _CRC_VERIFIED:
+        # First pass over a file version: native chunked scan WITH
+        # checksums, so a corrupt dataset fails loudly up front.  Later
+        # passes over the SAME (mtime, size) use the lighter per-record
+        # framing below (still native proto parse), which measures ~2×
+        # faster in steady state.
         verify = True
         with open(path, "rb") as f:
             leftover = b""
@@ -184,7 +212,7 @@ def _iter_tfrecord_raw(path: str) -> Iterator[bytes]:
                 chunk = f.read(_SCAN_CHUNK)
                 buf = leftover + chunk
                 if not buf:
-                    _CRC_VERIFIED.add(path)
+                    _CRC_VERIFIED.add(sig)
                     return
                 offs, lens, consumed = native.scan_records(
                     buf, verify_crc=verify)
@@ -196,7 +224,7 @@ def _iter_tfrecord_raw(path: str) -> Iterator[bytes]:
                         raise ValueError(
                             f"truncated TFRecord at end of {path} "
                             f"({len(leftover)} trailing bytes)")
-                    _CRC_VERIFIED.add(path)
+                    _CRC_VERIFIED.add(sig)
                     return
                 if consumed == 0 and len(buf) > 2**30:
                     # bounds RAM if a corrupt length field claims a
@@ -266,8 +294,9 @@ def _parse_example_image(payload: bytes) -> np.ndarray:
       Example.features(1) → Features.feature(1) map<string, Feature> →
       MapEntry{key(1), value(2)} → Feature{bytes_list(1)|int64_list(3)} →
       {BytesList,Int64List}.value(1).
-    Raises on malformed records (corruption must be loud, not a silent
-    dataset shrink).
+    Raises on malformed records; the TFRecord source catches the raise
+    and QUARANTINES the record (budgeted — docs/data.md) instead of
+    killing the run on a static defect.
     """
     from gansformer_tpu import native
 
@@ -327,73 +356,415 @@ def _parse_example_image(payload: bytes) -> np.ndarray:
     return arr
 
 
+# --- record-offset index (ISSUE 15 tentpole 1) -------------------------------
+
+_INDEX_VERSION = 1
+
+
+def _index_path(path: str) -> str:
+    return path + ".idx.npz"
+
+
+def _py_scan_frames(buf: bytes):
+    """Python framing fallback: (payload offsets, lengths, consumed) for
+    every COMPLETE record frame in ``buf`` — lengths trusted (no CRC),
+    mirroring the pre-index Python read path."""
+    offs: List[int] = []
+    lens: List[int] = []
+    pos = 0
+    n = len(buf)
+    while pos + 12 <= n:
+        (length,) = struct.unpack("<Q", buf[pos:pos + 8])
+        end = pos + 12 + length + 4
+        if length > 2**30 or end > n:
+            break                      # partial tail or hostile length
+        offs.append(pos + 12)
+        lens.append(length)
+        pos = end
+    return offs, lens, pos
+
+
+def build_record_index(path: str) -> dict:
+    """One streaming pass over a TFRecord file → the offset index:
+    ``offsets``/``lengths`` (np.int64, absolute payload spans) of every
+    record whose framing — and, with the native lib, payload CRC —
+    verifies, plus ``bad`` [(offset, length, cause)] for records
+    quarantined at scan time.  A tail whose framing cannot be walked
+    (torn file, corrupt length field) becomes ONE ``unframeable-tail``
+    entry covering the rest of the file — the scanner cannot resync
+    past a broken frame, but everything before it stays readable."""
+    from gansformer_tpu import native
+    from gansformer_tpu.data.tfrecord_writer import _masked_crc
+
+    lib = native.get_lib()
+    size = os.path.getsize(path)
+    offsets: List[int] = []
+    lengths: List[int] = []
+    bad: List[Tuple[int, int, str]] = []
+    base = 0                       # file offset of buf[0]
+    leftover = b""
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_SCAN_CHUNK)
+            buf = leftover + chunk
+            if not buf:
+                break
+            if lib is not None:
+                offs, lens, consumed = native.scan_records(
+                    buf, verify_crc=False)
+            else:
+                offs, lens, consumed = _py_scan_frames(buf)
+            for o, ln in zip(offs, lens):
+                o, ln = int(o), int(ln)
+                if lib is not None:
+                    (want,) = struct.unpack("<I", buf[o + ln:o + ln + 4])
+                    if _masked_crc(buf[o:o + ln]) != want:
+                        bad.append((base + o, ln, "payload-crc"))
+                        continue
+                offsets.append(base + o)
+                lengths.append(ln)
+            leftover = buf[consumed:]
+            base += consumed
+            if not chunk:              # EOF
+                if leftover:
+                    bad.append((base, len(leftover), "unframeable-tail"))
+                break
+            if consumed == 0 and len(buf) > 2**30:
+                # a corrupt length field claims a multi-GB record: stop
+                # scanning, quarantine the rest of the file as one span
+                bad.append((base, size - base, "unframeable-tail"))
+                break
+    return {"offsets": np.asarray(offsets, np.int64),
+            "lengths": np.asarray(lengths, np.int64),
+            "bad": bad}
+
+
+def load_record_index(path: str) -> dict:
+    """The file's record-offset index — from the ``<file>.idx.npz``
+    sidecar when it matches the file's (mtime_ns, size) signature, else
+    rebuilt by one scan pass and persisted (best-effort: a read-only
+    dataset dir keeps the index in memory for the process)."""
+    mtime_ns, size = _file_sig(path)
+    sidecar = _index_path(path)
+    if os.path.exists(sidecar):
+        try:
+            with np.load(sidecar, allow_pickle=False) as z:
+                if (int(z["version"]) == _INDEX_VERSION
+                        and int(z["mtime_ns"]) == mtime_ns
+                        and int(z["size"]) == size):
+                    return {
+                        "offsets": z["offsets"].astype(np.int64),
+                        "lengths": z["lengths"].astype(np.int64),
+                        "bad": [(int(o), int(ln), str(c)) for o, ln, c in
+                                zip(z["bad_offsets"], z["bad_lengths"],
+                                    z["bad_causes"])]}
+        except (OSError, ValueError, KeyError):
+            pass                       # torn/stale sidecar: rebuild
+    idx = build_record_index(path)
+    try:
+        tmp = f"{sidecar}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(
+                f, version=np.int64(_INDEX_VERSION),
+                mtime_ns=np.int64(mtime_ns), size=np.int64(size),
+                offsets=idx["offsets"], lengths=idx["lengths"],
+                bad_offsets=np.asarray([b[0] for b in idx["bad"]], np.int64),
+                bad_lengths=np.asarray([b[1] for b in idx["bad"]], np.int64),
+                bad_causes=np.asarray([b[2] for b in idx["bad"]], np.str_))
+        os.replace(tmp, sidecar)
+    except OSError:
+        pass                           # unwritable dataset dir: in-memory
+    return idx
+
+
+def _lod_of(fname: str) -> int:
+    m = re.findall(r"-r(\d+)", os.path.basename(fname))
+    return int(m[-1]) if m else -1
+
+
 class TFRecordDataset(Dataset):
-    """Reads the reference's multi-resolution TFRecord layout:
-    ``<dir>/<name>-r{02..10}.tfrecords`` + optional ``<name>-rxx.labels``
-    (SURVEY.md §3.4).  Only the max-resolution file is read (progressive
-    growing is not part of the GANsformer configs)."""
+    """Index-addressed reader of the reference's multi-resolution TFRecord
+    layout: ``<dir>/<name>-r{02..10}.tfrecords`` + optional ``*.labels``
+    (SURVEY.md §3.4).  ALL files matching the selected resolution are
+    read (a sharded dataset's shards are one logical source); each file
+    carries a record-offset index sidecar (``<file>.idx.npz``) built on
+    first pass, so every record is addressable by (file, offset, length):
+
+    * ``batches(start_batch=N)`` fast-forwards by advancing the RNG
+      stream only — kill→resume runs are tick-for-tick loss-identical
+      (the ROADMAP item 5 resume-exact contract, tests/test_supervise).
+    * Shuffling is per-epoch permutation of the shard-local good-record
+      set (every record exactly once per epoch, like the reference's
+      epoch-wide shuffle; ``shuffle_buffer`` is accepted for API compat
+      but the index makes the decoded-window reservoir unnecessary).
+    * Corrupt records (bad payload CRC at index build, malformed proto
+      at decode) are QUARANTINED — offset+cause appended to the
+      ``data_quarantine.jsonl`` ledger, ``data/corrupt_records_total``
+      incremented, the batch slot deterministically re-filled from the
+      next good record — and the run only fails typed (``DataCorrupt``)
+      once quarantined/total exceeds ``max_corrupt_frac``.
+    * Transient read errors retry under bounded exponential backoff
+      (``io_retries`` × ``io_retry_base_s``, ``data/read_retries_total``).
+
+    Fault points (supervise/faults.py): ``data_read_error`` /
+    ``data_slow_read`` fire before every record read (coordinate ``n`` =
+    monotonic read count), ``data_corrupt_record`` before every proto
+    parse (coordinate ``n`` = monotonic parse count).
+    """
 
     def __init__(self, path: str, resolution: Optional[int] = None,
-                 shuffle_buffer: int = 4096):
+                 shuffle_buffer: int = 4096,
+                 max_corrupt_frac: float = 0.01,
+                 io_retries: int = 3,
+                 io_retry_base_s: float = 0.05):
+        self.path = path
         files = sorted(glob.glob(os.path.join(path, "*.tfrecords")))
         if not files:
             raise FileNotFoundError(f"no .tfrecords under {path}")
+        match = []
         if resolution is not None:
             lod = int(np.log2(resolution))
-            match = [f for f in files if f"-r{lod:02d}" in f]
-            files = match or files
-        self.file = files[-1]  # highest resolution
+            match = [f for f in files
+                     if f"-r{lod:02d}" in os.path.basename(f)]
+        if not match:
+            # No (or no matching) lod tag: fall back to the highest
+            # single-resolution group — the pre-index reader's
+            # files[-1] behavior, but never a MIX of lods, which the
+            # shape check would read as mass corruption against the
+            # probed resolution (spurious DataCorrupt).
+            top = max(_lod_of(f) for f in files)
+            match = [f for f in files if _lod_of(f) == top]
+        files = match
+        self.files = files
+        self.file = files[-1]   # back-compat alias (pre-ISSUE-15 attr)
         self.shuffle_buffer = shuffle_buffer
-        first = _parse_example_image(next(_iter_tfrecord_raw(self.file)))
+        self.max_corrupt_frac = float(max_corrupt_frac)
+        self.io_retries = int(io_retries)
+        self.io_retry_base_s = float(io_retry_base_s)
+
+        self._c_corrupt = telemetry.counter("data/corrupt_records_total")
+        self._c_retries = telemetry.counter("data/read_retries_total")
+        self._g_frac = telemetry.gauge("data/corrupt_frac")
+        self._ledger_path: Optional[str] = None
+        self._pending_ledger: List[dict] = []
+        self._bad_seen: set = set()     # {(file_idx, offset)}
+        self._fds: dict = {}
+        self._reads = 0
+        self._parses = 0
+
+        # Per-file indexes → one flat addressable record table.  A good
+        # record's ORIGINAL position (its rank among good+bad records in
+        # file order) indexes the label array — labels stay aligned even
+        # when earlier records are quarantined.
+        rec_file: List[np.ndarray] = []
+        rec_off: List[np.ndarray] = []
+        rec_len: List[np.ndarray] = []
+        rec_orig: List[np.ndarray] = []
+        total_scanned = 0
+        for fi, fn in enumerate(self.files):
+            idx = load_record_index(fn)
+            offs, lens, bad = idx["offsets"], idx["lengths"], idx["bad"]
+            all_offs = np.sort(np.concatenate(
+                [offs, np.asarray([b[0] for b in bad], np.int64)]))
+            rec_file.append(np.full(len(offs), fi, np.int32))
+            rec_off.append(offs)
+            rec_len.append(lens)
+            rec_orig.append(total_scanned
+                            + np.searchsorted(all_offs, offs))
+            total_scanned += len(offs) + len(bad)
+            for off, ln, cause in bad:
+                self._note_bad(fi, int(off), int(ln), cause, check=False)
+        self._rec_file = np.concatenate(rec_file)
+        self._rec_off = np.concatenate(rec_off)
+        self._rec_len = np.concatenate(rec_len)
+        self._rec_orig = np.concatenate(rec_orig)
+        self._total_scanned = total_scanned
+        self.num_images = len(self._rec_off)
+        if self.num_images == 0:
+            raise DataCorrupt(
+                f"no readable records under {path} "
+                f"({len(self._bad_seen)} quarantined)")
+        self._check_budget()
+
+        first = self._read_parse(0)[1]
         self.resolution = first.shape[0]
         self.channels = first.shape[2]
+
         label_files = glob.glob(os.path.join(path, "*.labels"))
         self.labels = None
         if label_files:
             self.labels = np.load(label_files[0]).astype(np.float32)
+            if len(self.labels) != total_scanned:
+                raise ValueError(
+                    f"label file {label_files[0]} has {len(self.labels)} "
+                    f"rows but the matched record set "
+                    f"({len(self.files)} file(s)) holds {total_scanned} "
+                    f"records — labels would silently mis-align; "
+                    f"regenerate the labels beside the shards")
             self.has_labels = True
             self.label_dim = self.labels.shape[1]
 
-    # Byte budget for the decoded shuffle window: `shuffle_buffer` counts
-    # images, so cap it by bytes too or a 1024² dataset would hold ~12.9 GB
-    # per host at the 4096-image default.
-    SHUFFLE_BYTES_BUDGET = 512 * 1024 * 1024
+    # -- quarantine ----------------------------------------------------------
+
+    def set_quarantine_ledger(self, path: str) -> None:
+        self._ledger_path = path
+        pending, self._pending_ledger = self._pending_ledger, []
+        for rec in pending:
+            self._ledger_append(rec)
+
+    def _ledger_append(self, rec: dict) -> None:
+        if self._ledger_path is None:
+            self._pending_ledger.append(rec)
+            return
+        import json
+
+        with open(self._ledger_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def _note_bad(self, fi: int, off: int, ln: int, cause: str,
+                  check: bool = True) -> None:
+        key = (fi, off)
+        if key in self._bad_seen:
+            return
+        self._bad_seen.add(key)
+        self._c_corrupt.inc()
+        self._ledger_append({
+            "file": self.files[fi], "offset": off, "length": ln,
+            "cause": cause, "time": time.time(), "pid": os.getpid()})
+        if check:
+            self._g_frac.set(len(self._bad_seen)
+                             / max(self._total_scanned, 1))
+            self._check_budget()
+
+    def _check_budget(self) -> None:
+        frac = len(self._bad_seen) / max(self._total_scanned, 1)
+        self._g_frac.set(frac)
+        if frac > self.max_corrupt_frac:
+            raise DataCorrupt(
+                f"{len(self._bad_seen)}/{self._total_scanned} records "
+                f"quarantined ({frac:.1%}) exceeds max_corrupt_frac="
+                f"{self.max_corrupt_frac:g} under {self.path} — a static "
+                f"data defect; see the data_quarantine.jsonl ledger "
+                f"(restarting cannot fix this)")
+
+    # -- record IO -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Close every cached record fd (idempotent — raw fds are not
+        reclaimed by GC, so a process churning dataset instances would
+        otherwise leak one per shard per instance)."""
+        fds, self._fds = self._fds, {}
+        for fd in fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    def _fd(self, fi: int) -> int:
+        fd = self._fds.get(fi)
+        if fd is None:
+            fd = os.open(self.files[fi], os.O_RDONLY)
+            self._fds[fi] = fd
+        return fd
+
+    def _read_record(self, pos: int) -> bytes:
+        """Payload bytes of good-record ``pos``, retrying transient IO
+        errors under bounded exponential backoff (``os.pread`` — no seek
+        state, safe across generator instances)."""
+        fi = int(self._rec_file[pos])
+        off = int(self._rec_off[pos])
+        ln = int(self._rec_len[pos])
+        attempt = 0
+        while True:
+            self._reads += 1
+            try:
+                faults.fire("data_slow_read", n=self._reads)
+                faults.fire("data_read_error", n=self._reads)
+                data = os.pread(self._fd(fi), ln, off)
+                if len(data) != ln:
+                    # truncated-since-index: corruption, not a transient
+                    raise ValueError(
+                        f"short read ({len(data)}/{ln} bytes) at "
+                        f"{self.files[fi]}:{off}")
+                return data
+            except (OSError, faults.FaultInjected) as e:
+                old = self._fds.pop(fi, None)
+                if old is not None:
+                    try:
+                        os.close(old)
+                    except OSError:
+                        pass
+                attempt += 1
+                if attempt > self.io_retries:
+                    raise OSError(
+                        f"read of {self.files[fi]}@{off} failed after "
+                        f"{attempt} attempt(s): {e}") from e
+                self._c_retries.inc()
+                time.sleep(self.io_retry_base_s * (2 ** (attempt - 1)))
+
+    def _read_parse(self, pos: int) -> Tuple[int, np.ndarray]:
+        """Decode good-record ``pos`` — on a corrupt record, quarantine
+        it and deterministically substitute the next good record (the
+        same corrupt bytes map to the same substitute on every run, so
+        the stream stays resume-exact on a static defect)."""
+        for probe in range(self.num_images):
+            p = (pos + probe) % self.num_images
+            fi = int(self._rec_file[p])
+            try:
+                payload = self._read_record(p)
+                self._parses += 1
+                faults.fire("data_corrupt_record", n=self._parses)
+                arr = _parse_example_image(payload)
+                if getattr(self, "resolution", None) and arr.shape != (
+                        self.resolution, self.resolution, self.channels):
+                    raise ValueError(f"record shape {arr.shape} != dataset "
+                                     f"{(self.resolution, self.resolution, self.channels)}")
+                return p, arr
+            except (ValueError, IndexError, UnicodeDecodeError,
+                    faults.FaultInjected) as e:
+                self._note_bad(fi, int(self._rec_off[p]),
+                               int(self._rec_len[p]),
+                               f"{type(e).__name__}: {str(e)[:200]}")
+        raise DataCorrupt(f"no readable record left under {self.path}")
+
+    # -- stream --------------------------------------------------------------
 
     def batches(self, batch_size, seed=0, shard=(0, 1), start_batch=0):
-        # start_batch is accepted but NOT seekable here: the stream is a
-        # sequential file scan through a shuffle window, so a resumed
-        # run re-reads from the file head (best-effort resume — the
-        # strict tick-parity contract holds for index-addressed sources:
-        # synthetic/npz/folder).
-        del start_batch
         rs = np.random.RandomState(seed)
         shard_id, num_shards = shard
-        # Reservoir-style shuffle window (the tf.data shuffle_buffer analog):
-        # fill to `shuffle_buffer` decoded images, shuffle, drain half, refill.
-        img_bytes = self.resolution * self.resolution * self.channels
-        byte_cap = max(1, self.SHUFFLE_BYTES_BUDGET // img_bytes)
-        cap = max(min(self.shuffle_buffer, byte_cap), batch_size * 2)
-        buf: list = []
+        local = np.arange(shard_id, self.num_images, num_shards)
+        n = len(local)
+        if n < batch_size:
+            raise ValueError(
+                f"shard {shard_id}/{num_shards} holds {n} record(s) < "
+                f"batch_size {batch_size}")
+        per_epoch = n // batch_size
+        # Seekable fast-forward: whole epochs advance the permutation
+        # stream only (one rs.permutation call each — no decode), which
+        # is what makes kill→resume tick-parity exact on TFRecords.
+        epochs, r = divmod(start_batch, per_epoch)
+        for _ in range(epochs):
+            rs.permutation(n)
+        perm = rs.permutation(n)
+        pos = r * batch_size
         while True:
-            for i, payload in enumerate(_iter_tfrecord_raw(self.file)):
-                if i % num_shards != shard_id:
-                    continue  # per-host shard, no cross-host shuffle (§7.3.6)
-                buf.append((i, _parse_example_image(payload)))
-                if len(buf) >= cap:
-                    rs.shuffle(buf)
-                    while len(buf) > cap // 2 and len(buf) >= batch_size:
-                        take = [buf.pop() for _ in range(batch_size)]
-                        yield self._emit(take)
-            rs.shuffle(buf)  # epoch boundary: flush what's left
-            while len(buf) >= batch_size:
-                take = [buf.pop() for _ in range(batch_size)]
-                yield self._emit(take)
+            if pos + batch_size > per_epoch * batch_size:
+                perm = rs.permutation(n)
+                pos = 0
+            idx = local[perm[pos:pos + batch_size]]
+            pos += batch_size
+            yield self._emit(idx)
 
-    def _emit(self, items: Sequence[Tuple[int, np.ndarray]]) -> dict:
-        idx = np.array([i for i, _ in items])
-        out = {"image": np.stack([im for _, im in items])}
+    def _emit(self, idx: Sequence[int]) -> dict:
+        imgs = []
+        orig = []
+        for i in idx:
+            p, arr = self._read_parse(int(i))
+            imgs.append(arr)
+            orig.append(int(self._rec_orig[p]))
+        out = {"image": np.stack(imgs)}
         if self.labels is not None:
-            out["label"] = self.labels[idx % len(self.labels)]
+            out["label"] = self.labels[np.asarray(orig)]
         return out
 
 
@@ -445,37 +816,50 @@ class PrefetchIterator:
     Exceptions raised by the producer surface on the consumer's next
     ``next()``; ``close()`` (also via context manager) stops the thread.
 
+    Stall watchdog (ISSUE 15): with ``stall_after_s > 0``, a consumer
+    blocked on an empty queue while the producer makes NO progress for
+    that long raises typed ``DataStalled`` — a classified, fast data-hang
+    signal (wedged NFS read, hung decode) instead of waiting for the
+    supervisor's generic heartbeat-staleness SIGKILL.  Progress = items
+    landing in the queue, so ``stall_after_s`` must exceed the worst-case
+    single-batch decode time.
+
     Telemetry (obs/registry): ``data/prefetch_queue_depth`` gauge (ready
     batches waiting), ``data/starved_total`` counter (consumer arrived
     to an empty queue — the producer is the bottleneck), ``data/wait_ms``
-    histogram (per-``next()`` block time), ``data/batches_total``.
+    histogram (per-``next()`` block time), ``data/batches_total``,
+    ``data/stalls_total`` (watchdog verdicts).
     """
 
     _SENTINEL = object()
 
-    def __init__(self, iterator: Iterator[dict], depth: int = 2):
+    def __init__(self, iterator: Iterator[dict], depth: int = 2,
+                 stall_after_s: float = 0.0):
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._finished = False
         self._error: Optional[BaseException] = None
+        self._stall_after_s = float(stall_after_s or 0.0)
+        self._last_progress = time.monotonic()
         self._g_depth = telemetry.gauge("data/prefetch_queue_depth")
         self._c_starved = telemetry.counter("data/starved_total")
         self._c_batches = telemetry.counter("data/batches_total")
+        self._c_stalls = telemetry.counter("data/stalls_total")
         self._h_wait_ms = telemetry.histogram("data/wait_ms")
 
         def _produce():
-            from gansformer_tpu.supervise import faults
-
             try:
                 for n, item in enumerate(iterator):
                     # Fault-injection point: a 'hang' armed here models
-                    # the wedged data thread — the loop blocks in
-                    # data_wait, heartbeats go stale, and only the
-                    # supervisor's staleness probe ends the run.
+                    # the wedged data thread — with the watchdog armed
+                    # the consumer raises DataStalled; without it the
+                    # loop blocks in data_wait until the supervisor's
+                    # staleness probe ends the run.
                     faults.fire("data_thread", batch=n)
                     while not self._stop.is_set():
                         try:
                             self._queue.put(item, timeout=0.1)
+                            self._last_progress = time.monotonic()
                             self._g_depth.set(self._queue.qsize())
                             break
                         except queue.Full:
@@ -495,6 +879,15 @@ class PrefetchIterator:
         self._thread = threading.Thread(target=_produce, daemon=True)
         self._thread.start()
 
+    def _pop(self):
+        """Blocking pop under the shared stall-watchdog conviction rule
+        (``errors.stall_guarded_get`` — one algorithm for both prefetch
+        layers)."""
+        return stall_guarded_get(
+            self._queue, self._stall_after_s,
+            lambda: self._last_progress, self._c_stalls,
+            "data producer")
+
     def __iter__(self):
         return self
 
@@ -503,7 +896,7 @@ class PrefetchIterator:
             raise StopIteration
         starved = self._queue.empty()
         t0 = time.perf_counter()
-        item = self._queue.get()
+        item = self._pop()
         if item is self._SENTINEL:
             # end-of-stream teardown wait is not data starvation — don't
             # let it skew the input-bound diagnosis counters
@@ -553,7 +946,10 @@ def make_dataset(cfg) -> Dataset:
         return NpzDataset(cfg.path)
     if cfg.source == "tfrecord":
         return TFRecordDataset(cfg.path, resolution=cfg.resolution,
-                               shuffle_buffer=cfg.shuffle_buffer)
+                               shuffle_buffer=cfg.shuffle_buffer,
+                               max_corrupt_frac=cfg.max_corrupt_frac,
+                               io_retries=cfg.io_retries,
+                               io_retry_base_s=cfg.io_retry_base_s)
     if cfg.source == "folder":
         return ImageFolderDataset(cfg.path, resolution=cfg.resolution)
     raise ValueError(f"unknown data source {cfg.source!r}")

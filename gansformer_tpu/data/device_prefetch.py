@@ -33,6 +33,13 @@ Exceptions from the transfer thread (or the upstream iterator) surface
 on the consumer's next ``get()``; ``close()`` joins the thread.  Close
 the *upstream* iterator first — its end-of-stream sentinel is what
 unblocks a transfer thread waiting on an empty host queue.
+
+Stall watchdog (ISSUE 15): with ``stall_after_s > 0``, ``get()`` blocked
+on an empty ring while the transfer thread makes no progress for that
+long raises typed ``DataStalled`` — this is the layer that convicts a
+wedged ``device_put`` (upstream decode stalls are convicted by
+``PrefetchIterator``'s own watchdog and surface here as the stored
+error).
 """
 
 from __future__ import annotations
@@ -42,6 +49,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
+from gansformer_tpu.data.errors import stall_guarded_get
 from gansformer_tpu.obs import registry as telemetry
 
 
@@ -63,13 +71,16 @@ class DevicePrefetcher:
     _SENTINEL = object()
 
     def __init__(self, iterator: Iterator, put_fn: Callable,
-                 depth: int = 2):
+                 depth: int = 2, stall_after_s: float = 0.0):
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._finished = False
         self._error: Optional[BaseException] = None
+        self._stall_after_s = float(stall_after_s or 0.0)
+        self._last_progress = time.monotonic()
         self._g_depth = telemetry.gauge("data/device_queue_depth")
         self._c_batches = telemetry.counter("data/device_batches_total")
+        self._c_stalls = telemetry.counter("data/stalls_total")
         self._h_h2d_ms = telemetry.histogram("data/h2d_ms")
 
         def _produce():
@@ -91,6 +102,7 @@ class DevicePrefetcher:
                     while not self._stop.is_set():
                         try:
                             self._queue.put(dev, timeout=0.1)
+                            self._last_progress = time.monotonic()
                             self._g_depth.set(self._queue.qsize())
                             break
                         except queue.Full:
@@ -114,12 +126,21 @@ class DevicePrefetcher:
     def __iter__(self):
         return self
 
+    def _pop(self):
+        """Blocking ring pop under the shared stall-watchdog conviction
+        rule (``errors.stall_guarded_get`` — one algorithm for both
+        prefetch layers)."""
+        return stall_guarded_get(
+            self._queue, self._stall_after_s,
+            lambda: self._last_progress, self._c_stalls,
+            "device-prefetch transfer thread")
+
     def get(self):
         """Pop the next device-resident item (blocks if the transfer
         thread is behind — that block is the loop's ``data_wait``)."""
         if self._finished or self._stop.is_set():
             raise StopIteration
-        item = self._queue.get()
+        item = self._pop()
         if item is self._SENTINEL:
             self._finished = True
             if self._error is not None:

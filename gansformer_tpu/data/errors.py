@@ -1,0 +1,73 @@
+"""Typed data-plane failures (ISSUE 15).
+
+The fault-tolerant streaming plane distinguishes two terminal verdicts
+from the generic crash:
+
+* ``DataCorrupt`` — the corruption *budget* is exhausted: more than
+  ``DataConfig.max_corrupt_frac`` of the dataset's records are
+  quarantined.  This is a STATIC defect of the data on disk — restarting
+  cannot fix it, so the train CLI converts it into the distinct
+  ``events.EXIT_DATA_CORRUPT`` exit code and the supervisor classifies
+  the exit as non-retryable (``data-corrupt``) instead of burning its
+  restart budget on a crash loop.
+* ``DataStalled`` — the input pipeline's producer made no progress for
+  ``DataConfig.stall_after_s`` while the consumer waited.  A classified,
+  fast data-hang signal (wedged NFS mount, hung decode thread) that
+  reaches the loop long before the supervisor's generic
+  heartbeat-staleness probe would SIGKILL the whole run.  Possibly
+  transient, so its exit code (``events.EXIT_DATA_STALLED``) stays
+  retryable — but the cause lands classified in the availability ledger.
+
+Kept dependency-free (stdlib only) so the jax-free supervisor-side
+readers can name them in messages without importing the data plane.
+Also home to ``stall_guarded_get`` — the ONE conviction algorithm both
+prefetch layers (``PrefetchIterator``, ``DevicePrefetcher``) wrap their
+queue pops in, so the stall rule cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from typing import Callable
+
+
+class DataError(RuntimeError):
+    """Base of the typed data-plane failures."""
+
+
+class DataCorrupt(DataError):
+    """Corruption budget exhausted — a static, non-retryable data defect."""
+
+
+class DataStalled(DataError):
+    """The data producer made no progress within the stall budget."""
+
+
+def stall_guarded_get(q: "queue.Queue", stall_after_s: float,
+                      last_progress: Callable[[], float],
+                      stall_counter, what: str):
+    """``q.get()`` bounded by the producer-progress stall watchdog.
+
+    With ``stall_after_s <= 0`` this is a plain blocking get.  Otherwise
+    the wait is sliced, and a producer that makes NO progress (as
+    reported by the zero-arg ``last_progress`` monotonic-timestamp
+    callable) past the budget is convicted with typed ``DataStalled``
+    (after ``stall_counter.inc()``).  The clock measures from the LATER
+    of producer progress and entry to this wait, so a producer that was
+    merely blocked on a full queue is never convicted for the idle time.
+    """
+    if stall_after_s <= 0:
+        return q.get()
+    entered = time.monotonic()
+    while True:
+        try:
+            return q.get(timeout=min(1.0, stall_after_s / 4))
+        except queue.Empty:
+            now = time.monotonic()
+            ref = max(last_progress(), entered)
+            if now - ref > stall_after_s:
+                stall_counter.inc()
+                raise DataStalled(
+                    f"{what} made no progress for {now - ref:.0f}s "
+                    f"(stall_after_s={stall_after_s:g})") from None

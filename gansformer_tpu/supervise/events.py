@@ -37,9 +37,24 @@ SCHEMA_VERSION = 1
 # which is literally the supervisor's reading of it.
 EXIT_PREEMPTED = 75
 
+# Typed data-plane exits (ISSUE 15).  EX_DATAERR (65): the corruption
+# budget is exhausted — a STATIC defect of the data on disk, so the
+# supervisor treats it as non-retryable instead of crash-looping on it.
+# EX_IOERR (74): the input pipeline stalled past its watchdog — possibly
+# a transient filesystem wedge, so it stays retryable but classified.
+EXIT_DATA_CORRUPT = 65
+EXIT_DATA_STALLED = 74
+
 # The exit-cause vocabulary the supervisor classifies into; anything
 # else in the ledger is an "unclassified exit" the doctor WARNs on.
-CAUSES = ("clean", "crash", "preemption", "hang")
+CAUSES = ("clean", "crash", "preemption", "hang", "data-corrupt",
+          "data-stall")
+
+# Causes a restart cannot fix: the supervisor gives up immediately
+# WITHOUT consuming restart budget (the budget exists for transient
+# failures; burning it on a static defect is the crash loop ISSUE 15
+# closes).
+NON_RETRYABLE_CAUSES = ("data-corrupt",)
 
 # Event kinds the ledger schema lint accepts (telemetry_schema.py).
 KINDS = ("supervisor_start", "start", "exit", "resume", "elastic",

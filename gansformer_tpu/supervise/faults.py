@@ -33,7 +33,13 @@ Actions:
 
 Fire points wired today: ``ckpt_mid_write`` / ``ckpt_after_write``
 (train/checkpoint.py, step=), ``tick`` (train/loop.py, tick=/step=),
-``data_thread`` (data/dataset.py prefetch producer, batch=), and the
+``data_thread`` (data/dataset.py prefetch producer, batch=); the
+DATA-PLANE points (data/dataset.py TFRecord read path, ISSUE 15; coord:
+monotonic ``n``): ``data_read_error`` / ``data_slow_read`` (before every
+record read — ``raise`` exercises the bounded-backoff IO retry and
+``data/read_retries_total``; ``hang`` the stall watchdog → typed
+``DataStalled``), ``data_corrupt_record`` (before every proto parse —
+``raise`` exercises quarantine + the corruption budget); and the
 SERVING path (serve/service.py, ISSUE 13; coords: monotonic ``batch``
 plus ``n``): ``serve_dispatch`` (top of each dispatch iteration),
 ``serve_map`` (before the mapping dispatch), ``serve_fetch`` (inside

@@ -76,6 +76,10 @@ def classify_exit(returncode: int, killed_for_hang: bool = False) -> str:
         return "clean"
     if returncode == events.EXIT_PREEMPTED:
         return "preemption"        # graceful: checkpoint already on disk
+    if returncode == events.EXIT_DATA_CORRUPT:
+        return "data-corrupt"      # static data defect: non-retryable
+    if returncode == events.EXIT_DATA_STALLED:
+        return "data-stall"        # input pipeline stall: retry classified
     if returncode < 0 and -returncode == signal.SIGTERM:
         return "preemption"        # raw SIGTERM death: no final checkpoint
     return "crash"
@@ -143,7 +147,8 @@ class _Telemetry:
         # explicit-marker discipline — absence must mean "wiring rotted",
         # never "nothing happened yet".
         for c in ("restarts_total", "exits_total", "clean_exits_total",
-                  "crashes_total", "preemptions_total", "hangs_total"):
+                  "crashes_total", "preemptions_total", "hangs_total",
+                  "data_corrupt_exits_total", "data_stall_exits_total"):
             self.reg.counter(f"supervise/{c}")
         self.reg.gauge("supervise/restart_budget_remaining").set(
             cfg.max_restarts)
@@ -157,7 +162,9 @@ class _Telemetry:
         self.reg.counter("supervise/exits_total").inc()
         name = {"clean": "clean_exits_total", "crash": "crashes_total",
                 "preemption": "preemptions_total",
-                "hang": "hangs_total"}[cause]
+                "hang": "hangs_total",
+                "data-corrupt": "data_corrupt_exits_total",
+                "data-stall": "data_stall_exits_total"}[cause]
         self.reg.counter(f"supervise/{name}").inc()
         self.reg.gauge("supervise/last_exit_code").set(float(rc))
         self.reg.gauge("supervise/last_step").set(float(step))
@@ -311,6 +318,20 @@ def supervise(build_argv: Callable[[bool, int], List[str]],
                 return {"ok": False, "cause": "supervisor_preempted",
                         "restarts": restarts, "step": step,
                         "exit_code": events.EXIT_PREEMPTED}
+            if cause in events.NON_RETRYABLE_CAUSES:
+                # A restart cannot fix a static data defect: give up NOW
+                # with the cause classified and the restart budget
+                # untouched — the crash→restart loop on an unrecoverable
+                # cause is exactly what ISSUE 15 closes.
+                events.append_event(run_dir, "give_up",
+                                    restarts=restarts, cause=cause,
+                                    step=step, non_retryable=True)
+                log(f"non-retryable exit cause {cause!r}; giving up "
+                    f"without consuming the restart budget "
+                    f"({restarts} restart(s) used)")
+                return {"ok": False, "cause": cause,
+                        "restarts": restarts, "step": step,
+                        "exit_code": 1}
             if restarts >= cfg.max_restarts:
                 events.append_event(run_dir, "give_up",
                                     restarts=restarts, cause=cause,

@@ -239,9 +239,25 @@ def _train(cfg: ExperimentConfig, run_dir: str,
         enable_nan_debug()
         log.write("debug: jax_debug_nans ON (op-by-op NaN localization)")
 
+    # Data-plane robustness family (ISSUE 15): materialized up front so
+    # absence in telemetry.prom always means "wiring rotted", never
+    # "nothing went wrong" (the schema lint's explicit-marker
+    # discipline); the corrupt-frac budget gauge records the threshold
+    # the doctor judges the ratio against.
+    for c in ("data/read_retries_total", "data/corrupt_records_total",
+              "data/stalls_total"):
+        obs.get_registry().counter(c)
+    obs.get_registry().gauge("data/corrupt_frac").set(0.0)
+    obs.get_registry().gauge("data/corrupt_budget_frac").set(
+        cfg.data.max_corrupt_frac)
+
     # The dataset decides the conditional path: a labeled dataset switches
     # G/D into conditional mode unless the config already pinned label_dim.
     dataset = make_dataset(cfg.data)
+    # Corruption quarantine ledger (offset+cause per quarantined record):
+    # entries noted at index-build time flush here too.
+    dataset.set_quarantine_ledger(
+        os.path.join(run_dir, "data_quarantine.jsonl"))
     cfg = resolve_conditional(cfg, dataset)
     if jax.process_index() == 0:
         # Re-record the *resolved* config so generate/evaluate rebuild the
@@ -489,7 +505,8 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     # never waits on input (cfg.data.prefetch = queue depth in batches).
     # Constructed HERE, directly before the try, so the producer thread can
     # never leak if anything earlier raises.
-    batches = PrefetchIterator(batch_iter, depth=cfg.data.prefetch)
+    batches = PrefetchIterator(batch_iter, depth=cfg.data.prefetch,
+                               stall_after_s=cfg.data.stall_after_s)
 
     # Device-resident input prefetch (DataConfig.device_prefetch): a second
     # background thread pulls host batches, device_puts them onto their
@@ -524,7 +541,8 @@ def _train(cfg: ExperimentConfig, run_dir: str,
             return kind, {k: put(v) for k, v in d.items()}
 
         dev_batches = DevicePrefetcher(
-            host_plan(it), put_item, depth=cfg.data.device_prefetch_depth)
+            host_plan(it), put_item, depth=cfg.data.device_prefetch_depth,
+            stall_after_s=cfg.data.stall_after_s)
     # jax.profiler trace (SURVEY.md §5 tracing row): the trace runs between
     # the first and second tick boundaries, i.e. it captures the SECOND tick
     # window — the one the stats log labels ``Progress/tick: 1``.  The first
@@ -797,6 +815,9 @@ def _train(cfg: ExperimentConfig, run_dir: str,
         batches.close()
         if dev_batches is not None:
             dev_batches.close()
+        # Release the dataset's cached record fds only after both
+        # prefetch layers (its readers) have joined.
+        dataset.close()
         # Join in-flight background writes WITHOUT re-raising: on the
         # exceptional path a writer failure must not mask the training
         # exception already unwinding (it resurfaces via wait() below on

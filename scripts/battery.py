@@ -211,14 +211,28 @@ def default_stages():
         #    the window ledger; capture beats verdict (same rationale as
         #    graftcomms) — the stage completes on the SUPERVISE exit
         #    code (0 = trained through the injected crash).
+        #    Since ISSUE 15 the stage trains from a TFRECORD source (a
+        #    synthetic set converted up front — the reference's on-disk
+        #    format, read through the indexed fault-tolerant plane) and
+        #    arms a second fault, one transient read error
+        #    (raise@data_read_error), so every tunnel window also proves
+        #    the bounded-backoff IO retry path end to end:
+        #    data/read_retries_total lands in telemetry.prom and the
+        #    doctor's data_plane section grades it (WARN = the drill
+        #    worked; its JSON is archived either way).
         stage("train_ticks", 1200, None,
               ["sh", "-c",
-               f"{py} -m gansformer_tpu.cli.supervise"
+               f"{py} -m gansformer_tpu.cli.prepare_data --synthetic"
+               f" --to tfrecord --out {{win}}/train_tpu/data"
+               f" --resolution 256 --max-images 512 &&"
+               f" {py} -m gansformer_tpu.cli.supervise"
                f" --run-dir {{win}}/train_tpu/run"
                f" --max-restarts 4 --poll-interval 5"
                f" --heartbeat-max-age 300 --startup-grace 600"
-               f" --fault sigkill@ckpt_mid_write:step=4000 --"
-               f" --preset ffhq256-duplex --data-source synthetic"
+               f" --fault sigkill@ckpt_mid_write:step=4000"
+               f" --fault raise@data_read_error:n=64 --"
+               f" --preset ffhq256-duplex --data-source tfrecord"
+               f" --data-path {{win}}/train_tpu/data"
                f" --batch-size 8 --total-kimg 8 --fused-cycle"
                f" --device-time-ticks 0; rc=$?;"
                f" {py} -m gansformer_tpu.cli.telemetry doctor"

@@ -245,7 +245,13 @@ def test_lint_run_dir_findings_and_cli(tmp_path, capsys):
         "# TYPE compile_compiles_total counter\n"
         "compile_compiles_total 0.0\n"
         "# TYPE compile_retraces_total counter\n"
-        "compile_retraces_total 0.0\n")
+        "compile_retraces_total 0.0\n"
+        "# TYPE data_read_retries_total counter\n"
+        "data_read_retries_total 0.0\n"
+        "# TYPE data_corrupt_records_total counter\n"
+        "data_corrupt_records_total 0.0\n"
+        "# TYPE data_stalls_total counter\n"
+        "data_stalls_total 0.0\n")
     assert lint_run_dir(str(tmp_path)) == []
 
     rc = cli_main(["--run-dir", str(tmp_path)])
@@ -267,9 +273,11 @@ def test_check_metric_families_value_aware(tmp_path):
         check_metric_families)
 
     p = tmp_path / "telemetry.prom"
+    data = ("data_read_retries_total 0.0\n"
+            "data_corrupt_records_total 0.0\ndata_stalls_total 0.0\n")
     base = ("hbm_unavailable 0.0\nhbm_bytes_in_use 1.0\n"
             "hbm_peak_bytes 2.0\ncompile_compiles_total 1.0\n"
-            "compile_retraces_total 0.0\n")
+            "compile_retraces_total 0.0\n" + data)
     p.write_text("device_sampler_off 0.0\ndevice_samples_total 2.0\n"
                  + base)
     assert any("divergence" in e for e in check_metric_families(str(p)))
@@ -280,6 +288,33 @@ def test_check_metric_families_value_aware(tmp_path):
     # backend claims memory reporting but exports no numbers
     p.write_text("device_sampler_off 1.0\nhbm_unavailable 0.0\n"
                  "compile_compiles_total 1.0\n"
-                 "compile_retraces_total 0.0\n")
+                 "compile_retraces_total 0.0\n" + data)
     assert any("hbm_bytes_in_use" in e
                for e in check_metric_families(str(p)))
+
+
+def test_check_metric_families_data_robustness(tmp_path):
+    """ISSUE 15: the data/* robustness counters are REQUIRED (the loop
+    materializes them at setup — absence means rotted wiring), and a
+    moved quarantine counter demands the ledger evidence beside it."""
+    from gansformer_tpu.analysis.telemetry_schema import (
+        check_metric_families)
+
+    head = ("device_sampler_off 1.0\nhbm_unavailable 1.0\n"
+            "compile_compiles_total 1.0\ncompile_retraces_total 0.0\n")
+    p = tmp_path / "telemetry.prom"
+    # missing family members
+    p.write_text(head)
+    errs = check_metric_families(str(p))
+    for name in ("data_read_retries_total", "data_corrupt_records_total",
+                 "data_stalls_total"):
+        assert any(name in e for e in errs), (name, errs)
+    # quarantines moved without the jsonl ledger beside the prom
+    p.write_text(head + "data_read_retries_total 0.0\n"
+                 "data_corrupt_records_total 2.0\ndata_stalls_total 0.0\n")
+    assert any("data_quarantine.jsonl" in e
+               for e in check_metric_families(str(p)))
+    # ledger present → clean
+    (tmp_path / "data_quarantine.jsonl").write_text(
+        '{"file": "x", "offset": 0, "cause": "payload-crc"}\n')
+    assert check_metric_families(str(p)) == []

@@ -179,6 +179,14 @@ def test_train_ticks_stage_runs_under_supervisor():
     # the unattended-stage discipline survives the rewrite: device-time
     # sampler off (a killed trace can wedge the tunnel's claim)
     assert "--device-time-ticks 0" in argv
+    # ISSUE 15: the stage trains from a TFRECORD source (converted up
+    # front) with one injected transient read error, so every tunnel
+    # window also proves the bounded-backoff IO retry path
+    assert "gansformer_tpu.cli.prepare_data" in argv
+    assert "--to tfrecord" in argv
+    assert "--data-source tfrecord" in argv
+    assert "--data-path {win}/train_tpu/data" in argv
+    assert "--fault raise@data_read_error:n=64" in argv
 
 
 def test_default_probe_cmd_env_override(monkeypatch):

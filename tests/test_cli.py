@@ -48,6 +48,29 @@ def test_cli_overrides():
     assert config_from_args(args).train.device_time_ticks == 0
 
 
+def test_cli_data_plane_flags():
+    """ISSUE 15: the corruption budget, IO retry count, and stall
+    watchdog are flag-overridable; defaults inherit the config."""
+    args = build_parser().parse_args(["--preset", "clevr64-simplex"])
+    cfg = config_from_args(args)
+    assert cfg.data.max_corrupt_frac == 0.01
+    assert cfg.data.io_retries == 3
+    assert cfg.data.stall_after_s == 120.0
+    args = build_parser().parse_args([
+        "--preset", "clevr64-simplex", "--max-corrupt-frac", "0.1",
+        "--io-retries", "5", "--stall-after-s", "0"])
+    cfg = config_from_args(args)
+    assert cfg.data.max_corrupt_frac == 0.1
+    assert cfg.data.io_retries == 5
+    assert cfg.data.stall_after_s == 0.0
+    import pytest as _pytest
+
+    args = build_parser().parse_args([
+        "--preset", "clevr64-simplex", "--max-corrupt-frac", "1.5"])
+    with _pytest.raises(ValueError, match="max_corrupt_frac"):
+        config_from_args(args)
+
+
 def test_cli_defaults_valid():
     for name in PRESETS:
         args = build_parser().parse_args(["--preset", name])

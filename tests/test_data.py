@@ -653,3 +653,368 @@ def test_native_scanner_fuzz_hostile_bytes():
             native.parse_example(blob)
         except ValueError:
             pass
+
+
+# --- ISSUE 15: indexed, fault-tolerant TFRecord plane ------------------------
+
+def _tf_counter(name):
+    from gansformer_tpu.obs import registry as telemetry
+
+    return telemetry.counter(name).value
+
+
+@pytest.fixture
+def _no_faults():
+    from gansformer_tpu.supervise import faults
+
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _id_imgs(ids, res=8):
+    """CHW uint8 images whose every pixel encodes the image id."""
+    return [np.full((3, res, res), i, np.uint8) for i in ids]
+
+
+def test_tfrecord_multi_shard_reads_all_files(tmp_path):
+    """Satellite 1: a sharded dataset's shard files are ONE logical
+    source — the pre-fix reader kept only files[-1]."""
+    from gansformer_tpu.data.dataset import TFRecordDataset
+
+    _write_toy_records(str(tmp_path / "a-r03.tfrecords"), _id_imgs(range(16)))
+    _write_toy_records(str(tmp_path / "b-r03.tfrecords"),
+                       _id_imgs(range(16, 32)))
+    ds = TFRecordDataset(str(tmp_path))
+    assert ds.num_images == 32
+    assert len(ds.files) == 2
+    seen = []
+    it = ds.batches(4, seed=0)
+    for _ in range(8):               # one epoch
+        seen.extend(int(b[0, 0, 0]) for b in next(it)["image"])
+    assert sorted(seen) == list(range(32))  # both shards, exactly once
+
+
+def test_tfrecord_seek_matches_scan(tmp_path):
+    """Satellite 4 (non-slow half): start_batch=N reproduces the full
+    stream's batch N onward exactly — across epoch boundaries — by
+    advancing the RNG stream only (the resume-exact contract)."""
+    from gansformer_tpu.data.dataset import TFRecordDataset
+
+    _write_toy_records(str(tmp_path / "toy-r03.tfrecords"),
+                       _id_imgs(range(32)))
+    ds = TFRecordDataset(str(tmp_path))
+    ref = [next(it)["image"] for it in [ds.batches(4, seed=5)]
+           for _ in range(20)]       # 20 batches = 2.5 epochs (per_epoch 8)
+    for start in (3, 8, 11):         # mid-epoch, boundary, next epoch
+        resumed = ds.batches(4, seed=5, start_batch=start)
+        for want in ref[start:]:
+            np.testing.assert_array_equal(want, next(resumed)["image"])
+
+
+def test_tfrecord_index_sidecar_built_and_refreshed(tmp_path):
+    """The record-offset index persists beside the file and is rebuilt
+    when the file's (mtime, size) signature changes."""
+    from gansformer_tpu.data.dataset import (
+        TFRecordDataset, _index_path)
+    from gansformer_tpu.data.tfrecord_writer import (
+        encode_example_image, write_record)
+
+    path = str(tmp_path / "toy-r03.tfrecords")
+    _write_toy_records(path, _id_imgs(range(6)))
+    ds = TFRecordDataset(str(tmp_path))
+    assert ds.num_images == 6
+    assert os.path.exists(_index_path(path))
+    # grow the file: the stale sidecar must not hide the new records
+    with open(path, "ab") as f:
+        for img in _id_imgs(range(6, 8)):
+            write_record(f, encode_example_image(img))
+    ds2 = TFRecordDataset(str(tmp_path))
+    assert ds2.num_images == 8
+
+
+def test_tfrecord_garbage_proto_quarantined_under_budget(tmp_path):
+    """A record whose framing/CRC is valid but whose proto is garbage is
+    QUARANTINED (ledger line + counter), the batch slot is re-filled
+    deterministically, and the stream keeps flowing."""
+    import json
+
+    from gansformer_tpu.data.dataset import TFRecordDataset
+    from gansformer_tpu.data.tfrecord_writer import write_record
+
+    path = str(tmp_path / "toy-r03.tfrecords")
+    _write_toy_records(path, _id_imgs(range(16)))
+    with open(path, "ab") as f:
+        write_record(f, b"\x05not-a-proto")   # valid framing, bad proto
+    before = _tf_counter("data/corrupt_records_total")
+    ds = TFRecordDataset(str(tmp_path), max_corrupt_frac=0.2)
+    ledger = str(tmp_path / "data_quarantine.jsonl")
+    ds.set_quarantine_ledger(ledger)
+    assert ds.num_images == 17           # CRC-valid → in the index
+    seen = set()
+    it = ds.batches(4, seed=0)
+    for _ in range(12):                  # ~3 epochs
+        seen.update(int(b[0, 0, 0]) for b in next(it)["image"])
+    assert seen == set(range(16))        # every good image still flows
+    assert _tf_counter("data/corrupt_records_total") == before + 1
+    recs = [json.loads(l) for l in open(ledger)]
+    assert len(recs) == 1 and recs[0]["file"] == path
+    assert "cause" in recs[0] and "offset" in recs[0]
+    # determinism: the substitute mapping is stable, so two streams with
+    # the same seed agree batch for batch (resume-exact on a static defect)
+    a = ds.batches(4, seed=9)
+    b = TFRecordDataset(str(tmp_path), max_corrupt_frac=0.2).batches(
+        4, seed=9)
+    for _ in range(8):
+        np.testing.assert_array_equal(next(a)["image"], next(b)["image"])
+
+
+def test_tfrecord_payload_crc_quarantined_at_index_build(tmp_path):
+    """Native path: a flipped payload byte fails the per-record CRC at
+    index build — the record lands in the sidecar's bad list, not the
+    addressable set, and the rest of the file stays readable."""
+    from gansformer_tpu import native
+    from gansformer_tpu.data.dataset import TFRecordDataset, build_record_index
+
+    if native.get_lib() is None:
+        pytest.skip("no C++ toolchain — CRC verification is native-only")
+    path = str(tmp_path / "toy-r03.tfrecords")
+    _write_toy_records(path, _id_imgs(range(8)))
+    offs, lens, _ = native.scan_records(open(path, "rb").read(),
+                                        verify_crc=True)
+    data = bytearray(open(path, "rb").read())
+    data[int(offs[3]) + 7] ^= 0xFF       # corrupt record 3's payload
+    open(path, "wb").write(bytes(data))
+
+    idx = build_record_index(path)
+    assert len(idx["offsets"]) == 7
+    assert [c for _, _, c in idx["bad"]] == ["payload-crc"]
+    ds = TFRecordDataset(str(tmp_path), max_corrupt_frac=0.2)
+    assert ds.num_images == 7
+    seen = set()
+    it = ds.batches(7, seed=0)
+    seen.update(int(b[0, 0, 0]) for b in next(it)["image"])
+    assert seen == set(range(8)) - {3}
+
+
+def test_tfrecord_over_budget_raises_typed(tmp_path):
+    """Acceptance (c) unit: past max_corrupt_frac the failure is TYPED
+    (DataCorrupt), not a generic crash — at init when the index already
+    shows the breach, at stream time when decode failures cross it."""
+    from gansformer_tpu.data.dataset import TFRecordDataset
+    from gansformer_tpu.data.errors import DataCorrupt
+    from gansformer_tpu.data.tfrecord_writer import write_record
+
+    path = str(tmp_path / "toy-r03.tfrecords")
+    _write_toy_records(path, _id_imgs(range(16)))
+    with open(path, "ab") as f:
+        write_record(f, b"\x05not-a-proto")
+    ds = TFRecordDataset(str(tmp_path), max_corrupt_frac=0.0)
+    it = ds.batches(4, seed=0)
+    with pytest.raises(DataCorrupt, match="max_corrupt_frac"):
+        for _ in range(12):
+            next(it)
+
+
+def test_tfrecord_read_retry_via_fault(tmp_path, _no_faults):
+    """A transient read error (injected at the data_read_error point)
+    retries under bounded backoff: the counter moves, the stream is
+    unaffected."""
+    from gansformer_tpu.data.dataset import TFRecordDataset
+    from gansformer_tpu.supervise import faults
+
+    _write_toy_records(str(tmp_path / "toy-r03.tfrecords"),
+                       _id_imgs(range(16)))
+    ds = TFRecordDataset(str(tmp_path), io_retry_base_s=0.01)
+    ref = [next(it)["image"] for it in [ds.batches(4, seed=1)]
+           for _ in range(4)]
+    faults.arm(faults.parse_specs("raise@data_read_error:n=6"))
+    before = _tf_counter("data/read_retries_total")
+    got = [next(it)["image"] for it in [ds.batches(4, seed=1)]
+           for _ in range(4)]
+    assert _tf_counter("data/read_retries_total") == before + 1
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_tfrecord_read_error_exhausts_retries(tmp_path, monkeypatch):
+    """A PERSISTENT read error surfaces as an OSError after the bounded
+    retries (with the counter recording every attempt)."""
+    from gansformer_tpu.data.dataset import TFRecordDataset
+
+    _write_toy_records(str(tmp_path / "toy-r03.tfrecords"),
+                       _id_imgs(range(8)))
+    ds = TFRecordDataset(str(tmp_path), io_retries=2, io_retry_base_s=0.01)
+
+    def broken_pread(fd, n, off):
+        raise OSError("EIO: injected")
+
+    before = _tf_counter("data/read_retries_total")
+    monkeypatch.setattr(os, "pread", broken_pread)
+    with pytest.raises(OSError, match="failed after 3 attempt"):
+        next(ds.batches(4, seed=0))
+    assert _tf_counter("data/read_retries_total") == before + 2
+
+
+def test_prefetch_stall_watchdog_raises_typed():
+    """ISSUE 15 tentpole 3: a producer that stops making progress trips
+    the watchdog with typed DataStalled well before any heartbeat-
+    staleness kill."""
+    import time as _time
+
+    from gansformer_tpu.data.dataset import PrefetchIterator
+    from gansformer_tpu.data.errors import DataStalled
+
+    def stalling():
+        yield {"i": 0}
+        _time.sleep(30.0)
+        yield {"i": 1}
+
+    before = _tf_counter("data/stalls_total")
+    with PrefetchIterator(stalling(), depth=1, stall_after_s=0.3) as it:
+        assert next(it)["i"] == 0
+        t0 = _time.monotonic()
+        with pytest.raises(DataStalled, match="no progress"):
+            next(it)
+        assert _time.monotonic() - t0 < 10.0
+    assert _tf_counter("data/stalls_total") == before + 1
+
+
+def test_prefetch_no_watchdog_by_default():
+    from gansformer_tpu.data.dataset import PrefetchIterator
+
+    src = ({"i": i} for i in range(3))
+    with PrefetchIterator(src, depth=1) as it:
+        assert [b["i"] for b in it] == [0, 1, 2]
+
+
+def test_device_prefetch_stall_watchdog():
+    import time as _time
+
+    from gansformer_tpu.data.device_prefetch import DevicePrefetcher
+    from gansformer_tpu.data.errors import DataStalled
+
+    def stalling():
+        yield {"i": 0}
+        _time.sleep(30.0)
+
+    pf = DevicePrefetcher(stalling(), lambda x: x, depth=1,
+                          stall_after_s=0.3)
+    try:
+        assert pf.get()["i"] == 0
+        with pytest.raises(DataStalled, match="transfer thread"):
+            pf.get()
+    finally:
+        pf.close()
+
+
+def test_data_slow_read_hang_fault_trips_watchdog(tmp_path, _no_faults):
+    """The data_slow_read fault point + the watchdog close the loop: a
+    hung read thread becomes a fast typed verdict instead of a silent
+    data_wait block."""
+    from gansformer_tpu.data.dataset import PrefetchIterator, TFRecordDataset
+    from gansformer_tpu.data.errors import DataStalled
+    from gansformer_tpu.supervise import faults
+
+    _write_toy_records(str(tmp_path / "toy-r03.tfrecords"),
+                       _id_imgs(range(16)))
+    ds = TFRecordDataset(str(tmp_path))
+    faults.arm(faults.parse_specs("hang@data_slow_read:n=10"))
+    with PrefetchIterator(ds.batches(4, seed=0), depth=1,
+                          stall_after_s=0.3) as it:
+        with pytest.raises(DataStalled):
+            for _ in range(8):
+                next(it)
+
+
+def test_crc_verified_cache_keyed_by_signature(tmp_path):
+    """Satellite 2: an overwritten/regenerated file must NOT inherit the
+    previous version's 'CRC verified' verdict — the cache key carries
+    (mtime, size)."""
+    from gansformer_tpu import native
+    from gansformer_tpu.data.dataset import _iter_tfrecord_raw
+
+    if native.get_lib() is None:
+        pytest.skip("no C++ toolchain — CRC verification is native-only")
+    path = str(tmp_path / "v-r03.tfrecords")
+    _write_toy_records(path, _id_imgs(range(4)))
+    assert len(list(_iter_tfrecord_raw(path))) == 4   # pass 1: verified
+    assert len(list(_iter_tfrecord_raw(path))) == 4   # pass 2: light path
+
+    data = bytearray(open(path, "rb").read())
+    data[20] ^= 0xFF                                  # corrupt in place
+    open(path, "wb").write(bytes(data))
+    os.utime(path, ns=(1, 1))                         # force a new signature
+    with pytest.raises(ValueError, match="corrupt|truncated"):
+        list(_iter_tfrecord_raw(path))
+
+
+def test_tfrecord_labels_mismatch_raises(tmp_path):
+    """Satellite 3: a label array shorter than the record set used to
+    wrap silently (idx % len); now it is a loud init-time error."""
+    from gansformer_tpu.data.dataset import TFRecordDataset
+
+    _write_toy_records(str(tmp_path / "toy-r03.tfrecords"),
+                       _id_imgs(range(8)))
+    np.save(str(tmp_path / "toy-rxx.labels"),
+            np.eye(5, 4, dtype=np.float32))
+    os.rename(str(tmp_path / "toy-rxx.labels.npy"),
+              str(tmp_path / "toy-rxx.labels"))
+    with pytest.raises(ValueError, match="mis-align"):
+        TFRecordDataset(str(tmp_path))
+
+
+def test_tfrecord_labels_align_across_shards(tmp_path):
+    """Labels index the ORIGINAL record order across the whole shard
+    set: emitted (image, label) pairs must agree even through shuffling
+    and multi-file reads."""
+    from gansformer_tpu.data.dataset import TFRecordDataset
+
+    ids = list(range(12))
+    _write_toy_records(str(tmp_path / "a-r03.tfrecords"), _id_imgs(ids[:6]))
+    _write_toy_records(str(tmp_path / "b-r03.tfrecords"), _id_imgs(ids[6:]))
+    labels = np.zeros((12, 12), np.float32)
+    labels[np.arange(12), np.arange(12)] = 1.0        # one-hot of the id
+    np.save(str(tmp_path / "ab-rxx.labels"), labels)
+    os.rename(str(tmp_path / "ab-rxx.labels.npy"),
+              str(tmp_path / "ab-rxx.labels"))
+    ds = TFRecordDataset(str(tmp_path))
+    assert ds.has_labels and ds.label_dim == 12
+    it = ds.batches(4, seed=2)
+    for _ in range(6):
+        batch = next(it)
+        for img, lbl in zip(batch["image"], batch["label"]):
+            assert int(np.argmax(lbl)) == int(img[0, 0, 0])
+
+
+def test_tfrecord_resolution_miss_falls_back_to_one_lod_group(tmp_path):
+    """A --resolution with no matching shard falls back to the highest
+    single-lod group (the pre-index files[-1] spirit) — never a MIX of
+    lods, which the shape check would read as mass corruption."""
+    from gansformer_tpu.data.dataset import TFRecordDataset
+
+    _write_toy_records(str(tmp_path / "toy-r03.tfrecords"),
+                       _id_imgs(range(8), res=8))
+    _write_toy_records(str(tmp_path / "toy-r02.tfrecords"),
+                       _id_imgs(range(8), res=4))
+    ds = TFRecordDataset(str(tmp_path), resolution=64)   # no -r06 shard
+    assert [os.path.basename(f) for f in ds.files] == ["toy-r03.tfrecords"]
+    assert ds.resolution == 8 and ds.num_images == 8     # no quarantines
+    next(ds.batches(4, seed=0))
+
+
+def test_tfrecord_close_releases_fds(tmp_path):
+    from gansformer_tpu.data.dataset import TFRecordDataset
+
+    _write_toy_records(str(tmp_path / "toy-r03.tfrecords"),
+                       _id_imgs(range(8)))
+    ds = TFRecordDataset(str(tmp_path))
+    next(ds.batches(4, seed=0))
+    assert ds._fds                       # a cached fd from the reads
+    fd = next(iter(ds._fds.values()))
+    ds.close()
+    assert not ds._fds
+    with pytest.raises(OSError):
+        os.fstat(fd)                     # really closed
+    ds.close()                           # idempotent
+    next(ds.batches(4, seed=0))          # and reopenable

@@ -27,10 +27,13 @@ def synth_run_dir(tmp_path, *, gauges=None, counters=None, stats=None,
          "hbm/unavailable": 0.0, "hbm/bytes_in_use": 2e9,
          "hbm/peak_bytes": 4e9, "hbm/bytes_limit": 16e9,
          "data/prefetch_queue_depth": 2.0,
-         "data/device_queue_depth": 2.0}
+         "data/device_queue_depth": 2.0,
+         "data/corrupt_frac": 0.0, "data/corrupt_budget_frac": 0.01}
     g.update(gauges or {})
     c = {"device/samples_total": 2.0, "compile/compiles_total": 12.0,
-         "compile/retraces_total": 0.0, "data/starved_total": 0.0}
+         "compile/retraces_total": 0.0, "data/starved_total": 0.0,
+         "data/corrupt_records_total": 0.0, "data/read_retries_total": 0.0,
+         "data/stalls_total": 0.0}
     c.update(counters or {})
     rec = {"Progress/tick": 3, "Progress/kimg": 4.0,
            "timing/sec_per_tick": 10.0, "timing/img_per_sec": 100.0,
@@ -73,8 +76,8 @@ def test_healthy_run_all_pass(tmp_path):
     assert report["ok"] and report["n_fail"] == 0
     lv = levels(report)
     for name in ("artifacts", "progress", "device_truth", "mfu",
-                 "data_wait", "queues", "compiles", "hbm", "heartbeats",
-                 "restarts", "device_phases"):
+                 "data_wait", "queues", "data_plane", "compiles", "hbm",
+                 "heartbeats", "restarts", "device_phases"):
         assert lv[name] == "PASS", (name, lv)
     assert report["n_warn"] == 0
     # device phase table is ranked heaviest-first
@@ -327,3 +330,55 @@ def test_cli_doctor_json_modes(tmp_path, capsys):
     with pytest.raises(SystemExit) as e:
         cli_main(["doctor", bad, "--max-age", "1e-6"])
     assert e.value.code == 1
+
+
+# --- data-plane section (ISSUE 15) ------------------------------------------
+
+def test_data_plane_absent_on_pre_issue15_run_dirs(tmp_path):
+    d = synth_run_dir(tmp_path, name="legacy")
+    # strip the robustness family the way an old run dir would lack it
+    import json as _json
+
+    p = os.path.join(d, "stats.jsonl")
+    rec = _json.loads(open(p).read())
+    for k in ("data/corrupt_records_total", "data/read_retries_total",
+              "data/stalls_total"):
+        del rec["telemetry"]["counters"][k]
+    open(p, "w").write(_json.dumps(rec) + "\n")
+    assert "data_plane" not in levels(run_doctor(d, now=NOW))
+
+
+def test_data_plane_warn_on_quarantines_and_retries(tmp_path):
+    d = synth_run_dir(
+        tmp_path,
+        counters={"data/corrupt_records_total": 2.0,
+                  "data/read_retries_total": 3.0},
+        gauges={"data/corrupt_frac": 0.002})
+    with open(os.path.join(d, "data_quarantine.jsonl"), "w") as f:
+        f.write('{"file": "x", "offset": 1, "cause": "payload-crc"}\n' * 2)
+    rep = run_doctor(d, now=NOW)
+    assert rep["ok"]                       # WARN never fails the doctor
+    assert levels(rep)["data_plane"] == "WARN"
+    det = detail(rep, "data_plane")
+    assert "2 quarantined" in det and "2 ledger line(s)" in det \
+        and "3 read retries" in det
+
+
+def test_data_plane_fail_on_stall_kill(tmp_path):
+    d = synth_run_dir(tmp_path, counters={"data/stalls_total": 1.0})
+    rep = run_doctor(d, now=NOW)
+    assert not rep["ok"]
+    assert levels(rep)["data_plane"] == "FAIL"
+    assert "stall" in detail(rep, "data_plane")
+
+
+def test_data_plane_fail_on_budget_breach(tmp_path):
+    d = synth_run_dir(
+        tmp_path,
+        counters={"data/corrupt_records_total": 40.0},
+        gauges={"data/corrupt_frac": 0.04,
+                "data/corrupt_budget_frac": 0.01})
+    rep = run_doctor(d, now=NOW)
+    assert not rep["ok"]
+    assert levels(rep)["data_plane"] == "FAIL"
+    assert "budget" in detail(rep, "data_plane")
