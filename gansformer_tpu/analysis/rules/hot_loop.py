@@ -14,6 +14,17 @@ Any other ``block_until_ready`` / ``device_get`` call in a ``while``
 loop of those functions reintroduces a serial host stall per iteration
 (per request batch, on the serving side).
 
+The request tracer (obs/reqtrace.py, ISSUE 16) extends the serving hot
+path: its emitter bodies (``begin`` / ``event`` / ``batch_span`` and
+their private helpers) run per ticket per batch inside
+``_serve_dispatch``, so a sync hidden there stalls the loop just as
+surely as one written inline — but lives outside the ``while`` body
+the loop scan sees.  ``TRACE_EMITTERS`` closes that hole: those
+function bodies are scanned in full (no sanctioned span — a trace emit
+point has no business fetching from the device at all), gated on the
+reqtrace module path so an unrelated ``begin`` elsewhere stays out of
+scope.
+
 This rule complements host-sync-in-jit: the loop body is NOT a jit
 region (it's the host orchestrator), so the tracer-taint rule stays
 quiet there by design — this rule owns the loop-discipline half.
@@ -39,6 +50,20 @@ SANCTIONED_SPAN = "tick_fetch"
 # hot-loop function name -> its sanctioned fetch span
 HOT_LOOPS = {"_train": SANCTIONED_SPAN,
              "_serve_dispatch": "serve_fetch"}
+
+# request-trace emitter bodies (obs/reqtrace.py) — called per ticket
+# from _serve_dispatch, scanned in FULL with no sanctioned span
+TRACE_EMITTERS = {"begin", "event", "batch_span",
+                  "_finalize_locked", "_emit_chrome", "_flush_locked"}
+# a span name no `with span(...)` call can carry: nothing is sanctioned
+_NO_SPAN = "\x00no-sanctioned-span"
+
+
+def _is_reqtrace_path(path: Optional[str]) -> bool:
+    if not path:
+        return False
+    norm = path.replace(os.sep, "/")
+    return norm.endswith("obs/reqtrace.py") or norm.endswith("/reqtrace.py")
 
 _DEFAULT_TARGET = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
@@ -100,21 +125,32 @@ class HotLoopSync(Rule):
     id = "hot-loop-sync"
     description = ("block_until_ready/device_get in the per-iteration "
                    "while body of a hot loop (_train, _serve_dispatch) "
-                   "outside its sanctioned fetch span")
+                   "outside its sanctioned fetch span, or anywhere in a "
+                   "request-trace emitter body (obs/reqtrace.py)")
     hint = ("move the sync into the loop's sanctioned fetch span "
             "(tick_fetch / serve_fetch), or use copy_to_host_async "
-            "(non-blocking)")
+            "(non-blocking); trace emitters must never touch the device")
     node_types = (ast.FunctionDef, ast.AsyncFunctionDef)
 
     def check(self, node: ast.AST, ctx: FileContext) -> None:
         span_name = HOT_LOOPS.get(node.name)
-        if span_name is None:
+        if span_name is not None:
+            for v in _scan_hot_fn(node, span_name):
+                ctx.report(self, (v["line"], v["col"]),
+                           f"{v['call']}() in the hot loop outside "
+                           f"span(\"{span_name}\") — one host stall "
+                           f"per iteration")
             return
-        for v in _scan_hot_fn(node, span_name):
-            ctx.report(self, (v["line"], v["col"]),
-                       f"{v['call']}() in the hot loop outside "
-                       f"span(\"{span_name}\") — one host stall "
-                       f"per iteration")
+        if node.name in TRACE_EMITTERS and \
+                _is_reqtrace_path(getattr(ctx, "path", None)):
+            violations: List[dict] = []
+            _scan(node, False, violations, _NO_SPAN)
+            for v in violations:
+                ctx.report(self, (v["line"], v["col"]),
+                           f"{v['call']}() in trace emitter "
+                           f"{node.name}() — the serve dispatch loop "
+                           f"calls this per ticket; a host sync here "
+                           f"stalls every batch")
 
 
 # -- legacy entry points (scripts/check_hot_loop.py shim) --------------------

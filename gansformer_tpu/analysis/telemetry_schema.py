@@ -3,9 +3,15 @@
 The non-AST member of the analysis family: validates what a real (smoke)
 run actually wrote —
 
-* ``events.jsonl`` — every line is a Chrome-trace complete event:
-  ``name`` str, ``ph`` == "X", numeric non-negative ``ts``/``dur``,
-  integer ``pid``/``tid``.
+* ``events.jsonl`` — every line is a Chrome-trace event: complete
+  spans (``ph`` == "X" with numeric non-negative ``ts``/``dur``) or the
+  request tracer's async events (``ph`` in "b"/"n"/"e", which carry an
+  ``id`` instead of a ``dur``); always ``name`` str, numeric
+  non-negative ``ts``, integer ``pid``/``tid``.
+* ``requests.jsonl`` — the request tracer's ledger: one row per
+  terminal request with rid / outcome from the terminal vocabulary /
+  cause on non-fulfilled outcomes / monotone event timeline.
+  Values-aware against ``telemetry.prom``'s ``reqtrace_*`` counters.
 * ``telemetry.prom`` — Prometheus text exposition: well-formed
   ``# TYPE <name> <kind>`` comments, every sample line
   ``<legal_name> <float>``, and every sample's family declared by a
@@ -28,14 +34,17 @@ import glob
 import json
 import os
 import re
-from typing import List
+from typing import List, Optional
 
 from gansformer_tpu.analysis.findings import Finding
 
 PROM_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 PROM_TYPES = {"counter", "gauge", "summary", "histogram", "untyped"}
 EVENT_KEYS = {"name": str, "ph": str, "ts": (int, float),
-              "dur": (int, float), "pid": int, "tid": int}
+              "pid": int, "tid": int}
+# "X" = complete span (needs dur); b/n/e = the request tracer's async
+# begin/instant/end triple (needs the correlation id instead)
+EVENT_PHASES = {"X", "b", "n", "e"}
 HEARTBEAT_KEYS = {"process": int, "pid": int, "host": str,
                   "time": (int, float), "step": int, "kimg": (int, float)}
 
@@ -51,19 +60,133 @@ def check_events(path: str) -> List[str]:
             except ValueError as e:
                 errors.append(f"{path}:{i}: not JSON ({e})")
                 continue
-            for key, typ in EVENT_KEYS.items():
+            ph = ev.get("ph")
+            keys = dict(EVENT_KEYS)
+            if ph == "X":
+                keys["dur"] = (int, float)
+            elif ph in EVENT_PHASES:
+                keys["id"] = str
+            for key, typ in keys.items():
                 if key not in ev:
                     errors.append(f"{path}:{i}: missing {key!r}")
                 elif not isinstance(ev[key], typ) or \
                         isinstance(ev[key], bool):
                     errors.append(
                         f"{path}:{i}: {key}={ev[key]!r} is not {typ}")
-            if ev.get("ph") != "X":
-                errors.append(f"{path}:{i}: ph={ev.get('ph')!r} "
-                              f"(expected complete event 'X')")
+            if ph not in EVENT_PHASES:
+                errors.append(f"{path}:{i}: ph={ph!r} (expected one of "
+                              f"{sorted(EVENT_PHASES)})")
             for key in ("ts", "dur"):
                 if isinstance(ev.get(key), (int, float)) and ev[key] < 0:
                     errors.append(f"{path}:{i}: negative {key}")
+    return errors
+
+
+def check_requests(path: str,
+                   prom_path: Optional[str] = None) -> List[str]:
+    """``requests.jsonl`` ledger schema + cross-artifact consistency.
+
+    Row-level: rid str, outcome from the terminal vocabulary, a cause
+    on every non-fulfilled outcome, numeric non-negative ``e2e_ms``,
+    events a non-empty list opening with ``submitted`` at t 0, closing
+    with the outcome, kinds from the lifecycle vocabulary, timestamps
+    monotone non-decreasing.  Torn trailing lines are tolerated (a
+    killed service mid-append is this ledger's subject matter) — torn
+    lines mid-file are errors.
+
+    Values-aware (``prom_path`` given): when the tracer reports no
+    ledger overflow (``reqtrace_ledger_dropped_total`` == 0), the row
+    count must equal ``reqtrace_ledger_rows_total``; fulfilled rows
+    imply ``serve_requests_total`` moved — a ledger describing traffic
+    the service never counted means the two planes came from different
+    runs."""
+    from gansformer_tpu.obs.reqtrace import EVENT_KINDS, TERMINAL_KINDS
+
+    errors = []
+    with open(path) as f:
+        lines = [(i, line) for i, line in enumerate(f, 1)
+                 if line.strip()]
+    rows = []
+    for n, (i, line) in enumerate(lines):
+        try:
+            row = json.loads(line)
+        except ValueError as e:
+            if n == len(lines) - 1:
+                continue           # torn final append: expected ending
+            errors.append(f"{path}:{i}: not JSON ({e})")
+            continue
+        if not isinstance(row, dict):
+            errors.append(f"{path}:{i}: not a JSON object")
+            continue
+        rows.append(row)
+        if not isinstance(row.get("rid"), str):
+            errors.append(f"{path}:{i}: rid={row.get('rid')!r} "
+                          f"is not a string")
+        outcome = row.get("outcome")
+        if outcome not in TERMINAL_KINDS:
+            errors.append(f"{path}:{i}: outcome={outcome!r} outside "
+                          f"the terminal vocabulary {TERMINAL_KINDS}")
+        if outcome in TERMINAL_KINDS and outcome != "fulfilled" \
+                and not row.get("cause"):
+            errors.append(f"{path}:{i}: {outcome} row without a cause")
+        e2e = row.get("e2e_ms")
+        if not isinstance(e2e, (int, float)) or isinstance(e2e, bool) \
+                or e2e < 0:
+            errors.append(f"{path}:{i}: e2e_ms={e2e!r} is not a "
+                          f"non-negative number")
+        events = row.get("events")
+        if not isinstance(events, list) or not events:
+            errors.append(f"{path}:{i}: events is not a non-empty list")
+            continue
+        kinds = [ev.get("kind") for ev in events
+                 if isinstance(ev, dict)]
+        if len(kinds) != len(events):
+            errors.append(f"{path}:{i}: non-object event entry")
+            continue
+        for k in kinds:
+            if k not in EVENT_KINDS:
+                errors.append(f"{path}:{i}: event kind {k!r} outside "
+                              f"the lifecycle vocabulary")
+        if kinds and kinds[0] != "submitted":
+            errors.append(f"{path}:{i}: first event {kinds[0]!r} "
+                          f"(expected 'submitted')")
+        if kinds and outcome in TERMINAL_KINDS and kinds[-1] != outcome:
+            errors.append(f"{path}:{i}: last event {kinds[-1]!r} "
+                          f"does not match outcome {outcome!r}")
+        ts = [ev.get("t_ms") for ev in events]
+        if any(not isinstance(t, (int, float)) or isinstance(t, bool)
+               or t < 0 for t in ts):
+            errors.append(f"{path}:{i}: non-numeric or negative t_ms")
+        elif any(b < a for a, b in zip(ts, ts[1:])):
+            errors.append(f"{path}:{i}: event timeline not monotone")
+    seen = set()
+    for row in rows:
+        rid = row.get("rid")
+        if isinstance(rid, str):
+            if rid in seen:
+                errors.append(f"{path}: duplicate terminal row for "
+                              f"request {rid!r}")
+            seen.add(rid)
+    if prom_path is not None and os.path.exists(prom_path):
+        from gansformer_tpu.obs.registry import parse_prom_values
+
+        vals = parse_prom_values(prom_path)
+        ledgered = vals.get("reqtrace_ledger_rows_total")
+        dropped = vals.get("reqtrace_ledger_dropped_total", 0.0)
+        if ledgered is not None and dropped == 0.0 \
+                and len(rows) != int(ledgered):
+            errors.append(
+                f"{path}: {len(rows)} ledger rows but "
+                f"reqtrace_ledger_rows_total is {ledgered:g} with no "
+                f"overflow recorded — rows were lost outside the "
+                f"declared bound")
+        fulfilled = sum(1 for r in rows
+                        if r.get("outcome") == "fulfilled")
+        if fulfilled > 0 and vals.get("serve_requests_total", 0.0) <= 0:
+            errors.append(
+                f"{path}: {fulfilled} fulfilled rows but "
+                f"serve_requests_total never moved — ledger and prom "
+                f"describe different runs")
     return errors
 
 
@@ -217,7 +340,13 @@ def check_serve_metric_families(path: str,
                  "serve_cancelled_total",
                  "serve_dispatcher_restarts_total",
                  "serve_health_state", "serve_dispatcher_alive",
-                 "serve_queue_bound", "serve_queue_depth_now"):
+                 "serve_queue_bound", "serve_queue_depth_now",
+                 # the ISSUE 16 request-tracing family — materialized at
+                 # service init alongside the robustness family
+                 "reqtrace_requests_total", "reqtrace_events_total",
+                 "reqtrace_terminal_total", "reqtrace_dropped_total",
+                 "reqtrace_ledger_rows_total",
+                 "reqtrace_ledger_dropped_total", "reqtrace_enabled"):
         if name not in vals:
             errors.append(f"{path}: missing serve/* family member "
                           f"{name} (is the serving telemetry wired?)")
@@ -225,11 +354,58 @@ def check_serve_metric_families(path: str,
             vals.get("serve_e2e_ms_count", 0.0) <= 0:
         errors.append(f"{path}: requests were served but no "
                       f"serve_e2e_ms latency samples landed")
+    if vals.get("reqtrace_enabled", 0.0) > 0 and \
+            vals.get("serve_requests_total", 0.0) > 0:
+        # tracing was ON and traffic was admitted: traces must have
+        # opened AND reached terminal events — a nonzero gap between the
+        # two planes means ticket lifecycles are leaking mid-flight
+        if vals.get("reqtrace_requests_total", 0.0) <= 0:
+            errors.append(f"{path}: tracing enabled and requests "
+                          f"admitted but reqtrace_requests_total never "
+                          f"moved — request tracing rotted")
+        elif vals.get("reqtrace_terminal_total", 0.0) <= 0:
+            errors.append(f"{path}: traces opened but none reached a "
+                          f"terminal event — ticket lifecycles leak")
     if expect_overload and vals.get("serve_shed_total", 0.0) <= 0:
         errors.append(f"{path}: overload traffic was driven (bound "
                       f"{vals.get('serve_queue_bound', 0.0):g}) but "
                       f"serve_shed_total never moved — is admission "
                       f"control wired?")
+    return errors
+
+
+def check_fleet_metric_families(path: str) -> List[str]:
+    """Fleet-aggregation families (ISSUE 16): a ``fleet.prom`` written
+    by ``obs.aggregate`` must carry the roster gauges, the partial-view
+    marker, the step-skew / restart-asymmetry signals — the aggregator
+    materializes all of them unconditionally, so absence means the file
+    came from somewhere else.  Values-aware: a non-partial fleet must
+    have every rostered process reporting (the partial marker and the
+    roster arithmetic asserting the same fact is the cross-check that
+    catches a rotted marker)."""
+    from gansformer_tpu.obs.registry import parse_prom_values
+
+    vals = parse_prom_values(path)
+    errors = []
+    for name in ("fleet_partial", "fleet_processes",
+                 "fleet_processes_reporting", "fleet_processes_missing",
+                 "fleet_processes_stale", "fleet_step_skew",
+                 "fleet_heartbeat_age_max_s", "fleet_gauge_ts_conflict",
+                 "fleet_restarts_total", "fleet_restart_spread"):
+        if name not in vals:
+            errors.append(f"{path}: missing fleet/* family member "
+                          f"{name} (is this a fleet.prom?)")
+    total = vals.get("fleet_processes")
+    reporting = vals.get("fleet_processes_reporting")
+    if total is not None and reporting is not None:
+        if reporting > total:
+            errors.append(f"{path}: fleet_processes_reporting "
+                          f"{reporting:g} > fleet_processes {total:g}")
+        if vals.get("fleet_partial") == 0.0 and reporting < total:
+            errors.append(
+                f"{path}: fleet_partial claims a complete view but only "
+                f"{reporting:g}/{total:g} processes report — the "
+                f"partial marker rotted")
     return errors
 
 
@@ -365,6 +541,18 @@ def check_run_dir(run_dir: str) -> dict:
     if os.path.exists(sup_events):
         checked.append("supervisor_events.jsonl")
         errors += check_supervisor_events(sup_events)
+    # Request ledger and fleet rollup are likewise OPTIONAL (train-only
+    # runs have neither) but schema-checked when present.
+    requests = os.path.join(run_dir, "requests.jsonl")
+    if os.path.exists(requests):
+        checked.append("requests.jsonl")
+        errors += check_requests(
+            requests, prom_path=os.path.join(run_dir, "telemetry.prom"))
+    fleet_prom = os.path.join(run_dir, "fleet.prom")
+    if os.path.exists(fleet_prom):
+        checked.append("fleet.prom")
+        errors += check_prom(fleet_prom)
+        errors += check_fleet_metric_families(fleet_prom)
     return {"ok": not errors, "checked": checked, "errors": errors}
 
 

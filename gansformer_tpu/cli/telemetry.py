@@ -1,6 +1,6 @@
 """Telemetry CLI — inspect a run dir's observability artifacts.
 
-Four subcommands over the files the train loop writes
+Subcommands over the files the train loop and the serving floor write
 (docs/observability.md):
 
   trace       events.jsonl → Chrome-trace JSON (open in chrome://tracing
@@ -10,18 +10,32 @@ Four subcommands over the files the train loop writes
               (babysitter-scriptable)
   summary     per-phase totals aggregated from events.jsonl + the
               current telemetry.prom
+  requests    the request ledger (ISSUE 16): per-outcome summary, p99
+              exemplar resolution (the ``# EXEMPLAR`` line in
+              telemetry.prom names the request whose timeline explains
+              the worst latency), ``--id <rid>`` for one request's full
+              timeline, ``--worst N`` for the N slowest
+  slo         error budgets over declared objectives (p99 latency,
+              availability, shed rate): compliance, budget spend, burn
+              rate per objective; exit 1 when any budget is exhausted
+  fleet       aggregate N processes' telemetry into fleet.json /
+              fleet.prom (counters sum, gauges spread, histograms
+              merge; partial-view marker on degraded inputs)
   doctor      one run-health report cross-checking ALL of it (ISSUE 8):
               device-time vs wall-clock MFU, wall-vs-device divergence,
               data-wait fraction, queue depths, retraces, HBM headroom,
               heartbeat staleness + per-process step skew, restart
               count, — when a supervisor ledger exists — the
               availability section (ISSUE 12: exit causes, restart
-              storms, uptime ratio, give-up verdicts), and — when
-              serve/* telemetry or a serve_chaos.json artifact exists —
-              the serving section (ISSUE 13: circuit breaker, dead
+              storms, uptime ratio, give-up verdicts), — when serve/*
+              telemetry or a serve_chaos.json artifact exists — the
+              serving section (ISSUE 13: circuit breaker, dead
               dispatcher, shed rate, queue saturation, hung chaos
-              tickets).  PASS/WARN/FAIL lines; --json for the
-              machine-readable form; exit 0 iff no FAIL.
+              tickets), and — when served traffic is visible — the slo
+              section (ISSUE 16: FAIL on an exhausted error budget,
+              informational under a chaos drill).  PASS/WARN/FAIL
+              lines; --json for the machine-readable form; exit 0 iff
+              no FAIL.
 
 Examples
 --------
@@ -29,6 +43,10 @@ Examples
   python -m gansformer_tpu.cli.telemetry heartbeats results/00003-run \\
       --max-age 120 --expected 4
   python -m gansformer_tpu.cli.telemetry summary results/00003-run
+  python -m gansformer_tpu.cli.telemetry requests results/serve --worst 3
+  python -m gansformer_tpu.cli.telemetry slo results/serve --window 900
+  python -m gansformer_tpu.cli.telemetry fleet results/00003-run \\
+      --expected 4 --out-dir results/00003-run
   python -m gansformer_tpu.cli.telemetry doctor results/00003-run
   python -m gansformer_tpu.cli.telemetry doctor results \\
       --json-out doctor.json          # picks the latest numbered run
@@ -89,6 +107,70 @@ def summarize_events(events: List[dict]) -> List[dict]:
           "mean_ms": round(a["total_ms"] / a["count"], 3)}
          for n, a in agg.items()),
         key=lambda r: -r["total_ms"])
+
+
+# --- requests (ISSUE 16 tentpole a) -----------------------------------------
+
+
+def run_requests(run_dir: str, rid: Optional[str] = None,
+                 worst: Optional[int] = None) -> int:
+    """The ``requests`` subcommand body (returns the exit code).
+
+    Jax-free by construction: everything here reads artifacts through
+    ``obs.reqtrace.read_requests`` / ``obs.registry`` parsers — the CLI
+    runs on a laptop against an rsync'd run dir."""
+    from gansformer_tpu.obs.registry import parse_prom_exemplars
+    from gansformer_tpu.obs.reqtrace import read_requests, render_timeline
+
+    path = os.path.join(run_dir, "requests.jsonl")
+    rows = read_requests(path)
+    if not rows:
+        print(f"no request ledger rows under {run_dir} — was the "
+              f"service started with a requests.jsonl ledger "
+              f"(configure_reqtrace)?", file=sys.stderr)
+        return 1
+    if rid is not None:
+        hits = [r for r in rows if r.get("rid") == rid]
+        if not hits:
+            print(f"request {rid!r} not in {path} ({len(rows)} rows) — "
+                  f"evicted by the ledger bound, or a different run?",
+                  file=sys.stderr)
+            return 1
+        for row in hits:
+            print(render_timeline(row))
+        return 0
+    if worst is not None:
+        ranked = sorted(rows, key=lambda r: -(r.get("e2e_ms") or 0.0))
+        for row in ranked[:worst]:
+            print(render_timeline(row))
+            print()
+        return 0
+    # default: per-outcome summary + p99 exemplar resolution
+    by_outcome: Dict[str, int] = {}
+    for r in rows:
+        by_outcome[r.get("outcome", "?")] = \
+            by_outcome.get(r.get("outcome", "?"), 0) + 1
+    done = sorted(float(r.get("e2e_ms") or 0.0) for r in rows
+                  if r.get("outcome") == "fulfilled")
+    print(f"{len(rows)} request(s): " + ", ".join(
+        f"{k}={v}" for k, v in sorted(by_outcome.items())))
+    if done:
+        p50 = done[len(done) // 2]
+        p99 = done[min(int(len(done) * 0.99), len(done) - 1)]
+        print(f"fulfilled e2e: p50 {p50:.1f} ms, p99 {p99:.1f} ms, "
+              f"max {done[-1]:.1f} ms")
+    prom = os.path.join(run_dir, "telemetry.prom")
+    if os.path.exists(prom):
+        ex = parse_prom_exemplars(prom).get("serve_e2e_ms_max")
+        if ex:
+            hits = [r for r in rows if r.get("rid") == ex]
+            print(f"\np99 exemplar {ex} (serve_e2e_ms_max):")
+            if hits:
+                print(render_timeline(hits[0]))
+            else:
+                print(f"  not in the ledger (evicted by the row bound "
+                      f"or traced before the ledger was wired)")
+    return 0
 
 
 # --- doctor (ISSUE 8 tentpole c) --------------------------------------------
@@ -187,7 +269,8 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
                max_step_skew: Optional[int] = None,
                now: Optional[float] = None,
                max_restarts_per_hour: float = 6.0,
-               max_shed_rate: float = 0.01) -> dict:
+               max_shed_rate: float = 0.01,
+               slo_window_s: float = 3600.0) -> dict:
     """The run-health report as a pure-ish dict (rendered by
     ``render_doctor``; archived verbatim by ``--json``).
 
@@ -592,6 +675,40 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
             else:
                 check("serve_chaos", "PASS", cbits)
 
+    # -- SLO error budgets (ISSUE 16) ---------------------------------------
+    # Graded only when served traffic is visible (a requests.jsonl
+    # ledger or serve/* counters) — train-only run dirs skip the
+    # section.  FAIL on an exhausted error budget; under a chaos drill
+    # the spend is deliberate, so the section reports informationally
+    # instead of failing the doctor on its own fault injection.
+    from gansformer_tpu.obs.slo import evaluate_slos
+
+    slo_rep = evaluate_slos(run_dir, window_s=slo_window_s, now=now)
+    slo_graded = [o for o in slo_rep["objectives"]
+                  if o["status"] != "no_data"]
+    if slo_graded:
+        sbits = "; ".join(
+            "{}: {:.2%} of target {:.1%} (burn {:g})".format(
+                o["name"], o["compliance"], o["target"], o["burn_rate"])
+            for o in slo_graded)
+        sbits += (f" [{slo_rep['source']}"
+                  + (f", {slo_rep['rows']} row(s) in "
+                     f"{slo_rep['window_s']:g}s window"
+                     if slo_rep["source"] == "ledger" else "")
+                  + "]")
+        if slo_rep["exhausted"] and chaos_present:
+            check("slo", "PASS",
+                  f"budget(s) {slo_rep['exhausted']} spent under a "
+                  f"DELIBERATE chaos drill — not a capacity verdict; "
+                  f"{sbits}")
+        elif slo_rep["exhausted"]:
+            check("slo", "FAIL",
+                  f"error budget EXHAUSTED for "
+                  f"{', '.join(slo_rep['exhausted'])} — the service is "
+                  f"out of its declared objective; {sbits}")
+        else:
+            check("slo", "PASS", sbits)
+
     # -- device phase table (informational) ---------------------------------
     phase_ms = sorted(((k.split("/", 2)[2], v)
                        for k, v in tele.gauges.items()
@@ -640,6 +757,41 @@ def main(argv=None) -> None:
     s = sub.add_parser("summary", help="phase totals + current telemetry")
     s.add_argument("run_dir")
 
+    r = sub.add_parser("requests",
+                       help="request ledger: summary / timelines / p99 "
+                            "exemplar resolution")
+    r.add_argument("run_dir")
+    r.add_argument("--id", dest="rid", default=None, metavar="RID",
+                   help="render one request's full timeline")
+    r.add_argument("--worst", type=int, default=None, metavar="N",
+                   help="render the N slowest requests' timelines")
+
+    o = sub.add_parser("slo", help="error budgets over the declared "
+                                   "objectives (exit 1 when a budget "
+                                   "is exhausted)")
+    o.add_argument("run_dir")
+    o.add_argument("--window", type=float, default=3600.0,
+                   help="rolling window in seconds over the request "
+                        "ledger (default 3600)")
+    o.add_argument("--json", action="store_true",
+                   help="print the machine-readable report")
+
+    fl = sub.add_parser("fleet",
+                        help="aggregate N processes' telemetry into "
+                             "fleet.json / fleet.prom")
+    fl.add_argument("run_dirs", nargs="+",
+                    help="ONE shared run dir (heartbeat-p*.json roster) "
+                         "or several per-process run dirs")
+    fl.add_argument("--expected", type=int, default=None,
+                    help="expected process count (missing processes "
+                         "mark the view partial)")
+    fl.add_argument("--max-age", type=float, default=None,
+                    help="heartbeats older than this many seconds mark "
+                         "the view partial")
+    fl.add_argument("--out-dir", default=None, metavar="DIR",
+                    help="write fleet.json + fleet.prom under DIR "
+                         "(default: print the JSON to stdout only)")
+
     d = sub.add_parser("doctor", help="one-shot run-health report "
                                       "(PASS/WARN/FAIL; exit 0 iff no "
                                       "FAIL)")
@@ -668,6 +820,9 @@ def main(argv=None) -> None:
     d.add_argument("--max-shed-rate", type=float, default=0.01,
                    help="serving-section shed-rate threshold (above "
                         "this → WARN)")
+    d.add_argument("--slo-window", type=float, default=3600.0,
+                   help="rolling window in seconds for the slo "
+                        "section's ledger-based budgets")
 
     args = p.parse_args(argv)
 
@@ -692,7 +847,8 @@ def main(argv=None) -> None:
                             expected=args.expected,
                             max_step_skew=args.max_skew,
                             max_restarts_per_hour=args.max_restarts_hour,
-                            max_shed_rate=args.max_shed_rate)
+                            max_shed_rate=args.max_shed_rate,
+                            slo_window_s=args.slo_window)
         if args.json_out:
             with open(args.json_out, "w") as f:
                 json.dump(report, f, indent=1, sort_keys=True)
@@ -703,6 +859,35 @@ def main(argv=None) -> None:
             print(render_doctor(report))
         if not report["ok"]:
             sys.exit(1)
+    elif args.cmd == "requests":
+        sys.exit(run_requests(args.run_dir, rid=args.rid,
+                              worst=args.worst))
+    elif args.cmd == "slo":
+        from gansformer_tpu.obs.slo import evaluate_slos, render_slos
+
+        report = evaluate_slos(args.run_dir, window_s=args.window)
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            print(render_slos(report))
+        if report["exhausted"]:
+            sys.exit(1)
+    elif args.cmd == "fleet":
+        from gansformer_tpu.obs.aggregate import aggregate_fleet, \
+            write_fleet
+
+        target = (args.run_dirs[0] if len(args.run_dirs) == 1
+                  else args.run_dirs)
+        fleet = aggregate_fleet(target, expected=args.expected,
+                                max_age_s=args.max_age)
+        if args.out_dir:
+            json_path, prom_path = write_fleet(fleet, args.out_dir)
+            print(f"wrote {json_path} and {prom_path}"
+                  + (" (PARTIAL view: "
+                     + "; ".join(fleet["partial_reasons"]) + ")"
+                     if fleet["partial"] else ""))
+        else:
+            print(json.dumps(fleet, indent=1, sort_keys=True))
     elif args.cmd == "summary":
         for row in summarize_events(read_events(args.run_dir)):
             print("{name:<28s} n={count:<6d} total {total_ms:>10.1f} ms  "

@@ -18,6 +18,18 @@ Three layers, each importable without jax/tensorflow so host-side tools
   gated ``jax.profiler`` windows parsed into ``device/*`` gauges
   (device-time MFU, per-program device ms, wall-vs-device divergence).
   The one layer that DOES import jax — lazily, inside methods.
+* ``reqtrace`` — per-request lifecycle tracing for the serving plane
+  (ISSUE 16): request IDs minted at submit, causal event timelines
+  through the continuous-batching dispatcher, terminal outcomes with
+  causes, a bounded ``requests.jsonl`` ledger, and Chrome async events
+  merged into the same ``events.jsonl`` the spans write.
+* ``aggregate`` — fleet telemetry aggregation: N processes' prom /
+  heartbeat / supervisor artifacts folded into ``fleet.json`` /
+  ``fleet.prom`` with declared merge semantics (counters sum, gauges
+  spread, histograms merge) and a never-raise partial-view contract.
+* ``slo`` — declared service objectives (latency / availability / shed)
+  graded into error budgets and burn rates over rolling windows of the
+  request ledger, with lifetime-counter fallback.
 
 The train loop wires all of them (train/loop.py); the data pipeline,
 checkpointing, and metric layers record into the registry directly.
@@ -26,12 +38,19 @@ checkpointing, and metric layers record into the registry directly.
 report.
 """
 
+from gansformer_tpu.obs.aggregate import (  # noqa: F401
+    aggregate_fleet, fleet_prom_text, write_fleet)
 from gansformer_tpu.obs.device_time import DeviceTimeSampler  # noqa: F401
 from gansformer_tpu.obs.heartbeat import (  # noqa: F401
     Heartbeat, check_heartbeats, device_memory_stats, read_heartbeats,
     sample_hbm)
 from gansformer_tpu.obs.registry import (  # noqa: F401
     Registry, counter, gauge, get_registry, histogram)
+from gansformer_tpu.obs.reqtrace import (  # noqa: F401
+    ReqTracer, configure_reqtrace, get_reqtracer, read_requests,
+    render_timeline)
+from gansformer_tpu.obs.slo import (  # noqa: F401
+    DEFAULT_OBJECTIVES, evaluate_slos, render_slos)
 from gansformer_tpu.obs.spans import (  # noqa: F401
     Tracer, configure_tracer, get_tracer, span)
 

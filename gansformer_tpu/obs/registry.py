@@ -71,6 +71,22 @@ def parse_prom_values(path: str) -> Dict[str, float]:
     return out
 
 
+def parse_prom_exemplars(path: str) -> Dict[str, str]:
+    """``# EXEMPLAR <sample_name> <label>`` comment lines →
+    {sample name: label}.  The read half of the histogram exemplar
+    channel (``Histogram.observe(v, exemplar=...)``): the requests CLI
+    resolves ``serve_e2e_ms_max`` here to the request ID whose timeline
+    explains the p99 outlier."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 4 and parts[0] == "#" and \
+                    parts[1] == "EXEMPLAR":
+                out[parts[2]] = parts[3]
+    return out
+
+
 class Counter:
     """Monotonic count.  ``inc()`` only — decrements are a gauge's job."""
 
@@ -113,7 +129,15 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming count/sum/min/max of observations."""
+    """Streaming count/sum/min/max of observations.
+
+    ``observe(v, exemplar=...)`` may attach an exemplar label (a request
+    ID) to the observation; the histogram retains the exemplar of its
+    CURRENT max, so a p99 outlier in ``serve/e2e_ms`` links straight to
+    the request timeline that produced it (exported as a ``# EXEMPLAR``
+    comment line in the prom text — comments are transparent to
+    ``parse_prom_values`` and the schema lint, so the channel costs the
+    readers nothing)."""
 
     def __init__(self, name: str, lock: threading.Lock):
         self.name = name
@@ -122,14 +146,18 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self.max_exemplar: Optional[str] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         with self._lock:
             self.count += 1
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
-            self.max = v if self.max is None else max(self.max, v)
+            if self.max is None or v >= self.max:
+                self.max = v
+                if exemplar is not None:
+                    self.max_exemplar = str(exemplar)
 
     @property
     def mean(self) -> float:
@@ -185,8 +213,10 @@ class Registry:
                 "counters": {n: c.value for n, c in self._counters.items()},
                 "gauges": {n: g.value for n, g in self._gauges.items()},
                 "histograms": {
-                    n: {"count": h.count, "sum": h.sum, "mean": h.mean,
-                        "min": h.min, "max": h.max}
+                    n: ({"count": h.count, "sum": h.sum, "mean": h.mean,
+                         "min": h.min, "max": h.max}
+                        | ({"max_exemplar": h.max_exemplar}
+                           if h.max_exemplar is not None else {}))
                     for n, h in self._histograms.items()},
             }
 
@@ -214,6 +244,12 @@ class Registry:
                 if h.count:
                     lines.append(f"{pn}_min {fmt(h.min)}")
                     lines.append(f"{pn}_max {fmt(h.max)}")
+                    if h.max_exemplar is not None:
+                        # comment channel: readers that don't know about
+                        # exemplars (parse_prom_values, check_prom) skip
+                        # '#' lines by contract
+                        lines.append(f"# EXEMPLAR {pn}_max "
+                                     f"{h.max_exemplar}")
         return "\n".join(lines) + "\n" if lines else ""
 
     def write_prom(self, path: str) -> None:

@@ -1,0 +1,333 @@
+"""Per-request distributed tracing — "what happened to request X?".
+
+The serving floor (serve/service.py) answers aggregate questions
+(p50/p99, shed rate) through the registry; this module answers the
+per-request one.  Every ``Ticket`` gets a request ID at submit and a
+lifecycle event stream::
+
+    submitted → admitted → popped → batched → wcache_hit|map_dispatch
+              → synth → fetch → fulfilled
+                               └ terminal: shed / expired / cancelled /
+                                 failed (with a cause)
+
+Design constraints, in order:
+
+* **No host sync, bounded overhead.**  Every emit point is a dict
+  append under one lock — never a device fetch, file write, or
+  allocation proportional to traffic.  The serve dispatch loop calls
+  these per ticket per batch, so the hot-loop-sync lint
+  (analysis/rules/hot_loop.py) scans the emitter bodies too.
+* **No open-ended growth.**  Active traces are capped
+  (``max_active``; overflow evicts oldest-first into
+  ``reqtrace/dropped_total``), the ledger is capped
+  (``max_ledger_rows``; overflow counted in
+  ``reqtrace/ledger_dropped_total``), and the in-memory recent ring is
+  a fixed deque.  Silent truncation is forbidden — every bound has a
+  counter.
+* **Two export forms.**  A bounded ``requests.jsonl`` ledger (one JSON
+  row per terminal request: outcome, cause, e2e, the full event list)
+  and Chrome-trace async events (``ph`` b/n/e keyed by the request ID)
+  merged into the span tracer's ``events.jsonl`` — so one
+  chrome://tracing load shows batches AND the requests they carried.
+  Batch→request causal linkage is explicit both ways: each dispatch
+  batch emits a ``serve_batch`` complete event listing its request IDs,
+  and each request's ``batched`` event carries the batch number.
+
+Jax-free (the CLI renders timelines from artifacts on machines with no
+accelerator stack).  The process-global tracer (``get_reqtracer()``)
+is what the service uses; tests construct private ``ReqTracer``
+instances with fake clocks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional
+
+from gansformer_tpu.obs import registry as telemetry
+from gansformer_tpu.obs.spans import get_tracer
+
+# terminal event kinds — every submitted request must reach exactly one
+TERMINAL_KINDS = ("fulfilled", "shed", "expired", "cancelled", "failed")
+# the full lifecycle vocabulary (docs/observability.md catalog)
+EVENT_KINDS = ("submitted", "admitted", "popped", "batched", "wcache_hit",
+               "map_dispatch", "synth", "fetch") + TERMINAL_KINDS
+
+# ledger rows buffered in memory before an incremental append
+_LEDGER_FLUSH_EVERY = 64
+
+
+class ReqTracer:
+    """Request-ID allocator + per-request event recorder.
+
+    ``begin()`` opens a trace (emitting ``submitted``), ``event()``
+    appends lifecycle events, a terminal kind finalizes: the trace
+    leaves the active table, lands in the recent ring, and — when a
+    ledger is configured — is buffered for append to
+    ``requests.jsonl``.  All methods are cheap no-ops while
+    ``enabled`` is False (the measured-overhead A/B switch)."""
+
+    def __init__(self, time_fn: Callable[[], float] = time.perf_counter,
+                 wall_fn: Callable[[], float] = time.time):
+        self._time = time_fn
+        self._wall = wall_fn
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._pid = os.getpid()
+        self._active: "OrderedDict[str, dict]" = OrderedDict()
+        self._recent: "deque[dict]" = deque(maxlen=4096)
+        self._buffer: List[dict] = []
+        self._ledger_path: Optional[str] = None
+        self._ledger_rows = 0
+        self._max_ledger_rows = 20000
+        self._max_active = 65536
+        self._chrome = True
+        self.enabled = True
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, ledger_path: Optional[str] = None,
+                  max_ledger_rows: int = 20000, truncate: bool = True,
+                  enabled: bool = True, max_active: int = 65536,
+                  chrome_events: bool = True) -> "ReqTracer":
+        """Point the tracer at a run dir's ``requests.jsonl`` (or None:
+        in-memory only — the recent ring still serves the chaos drill's
+        terminal-coverage assertion).  Materializes the ``reqtrace/*``
+        counter family so absence in telemetry.prom always means the
+        wiring rotted, never "no traffic yet"."""
+        with self._lock:
+            self._flush_locked()
+            self._ledger_path = ledger_path
+            self._max_ledger_rows = int(max_ledger_rows)
+            self._max_active = int(max_active)
+            self._chrome = bool(chrome_events)
+            self.enabled = bool(enabled)
+            self._ledger_rows = 0
+            if ledger_path and (truncate
+                                or not os.path.exists(ledger_path)):
+                parent = os.path.dirname(ledger_path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                open(ledger_path, "w").close()
+        for name in ("reqtrace/requests_total", "reqtrace/events_total",
+                     "reqtrace/terminal_total", "reqtrace/dropped_total",
+                     "reqtrace/ledger_rows_total",
+                     "reqtrace/ledger_dropped_total"):
+            telemetry.counter(name)
+        # the explicit on/off marker: "zero trace counters" must never
+        # be ambiguous between "tracing disabled" and "wiring rotted"
+        telemetry.gauge("reqtrace/enabled").set(1.0 if enabled else 0.0)
+        return self
+
+    def reset(self) -> None:
+        """Drop active traces, the recent ring, and buffered rows (run
+        start; the ID sequence keeps counting so IDs stay unique per
+        process)."""
+        with self._lock:
+            self._active.clear()
+            self._recent.clear()
+            self._buffer.clear()
+            self._ledger_rows = 0
+
+    # -- recording -----------------------------------------------------------
+
+    def begin(self, seed=None, psi=None) -> Optional[str]:
+        """Open a trace; returns the new request ID (None while
+        disabled).  Emits the ``submitted`` event at t=0."""
+        if not self.enabled:
+            return None
+        t0 = self._time()
+        with self._lock:
+            self._seq += 1
+            rid = f"r{self._pid}-{self._seq}"
+            evicted = None
+            if len(self._active) >= self._max_active:
+                # oldest-first eviction: a leak upstream (tickets that
+                # never resolve) must not grow this table unboundedly
+                _, evicted = self._active.popitem(last=False)
+            self._active[rid] = {
+                "rid": rid, "t0": t0, "t_wall": self._wall(),
+                "seed": seed, "psi": psi, "batch": None,
+                "events": [["submitted", 0.0, None]],
+            }
+        telemetry.counter("reqtrace/requests_total").inc()
+        telemetry.counter("reqtrace/events_total").inc()
+        if evicted is not None:
+            telemetry.counter("reqtrace/dropped_total").inc()
+        return rid
+
+    def event(self, rid: Optional[str], kind: str, **attrs) -> None:
+        """Append one lifecycle event; a terminal kind finalizes the
+        trace.  Unknown/None rids are ignored (a late event against an
+        evicted trace must not crash the dispatcher)."""
+        if not self.enabled or rid is None:
+            return
+        t = self._time()
+        row = None
+        with self._lock:
+            rec = self._active.get(rid)
+            if rec is None:
+                return
+            dt_ms = round((t - rec["t0"]) * 1000.0, 3)
+            rec["events"].append([kind, dt_ms, attrs or None])
+            if "batch" in attrs:
+                rec["batch"] = attrs["batch"]
+            if kind in TERMINAL_KINDS:
+                del self._active[rid]
+                row = self._finalize_locked(rec, kind, dt_ms, attrs)
+        telemetry.counter("reqtrace/events_total").inc()
+        if row is not None:
+            telemetry.counter("reqtrace/terminal_total").inc()
+            if row.get("_ledgered"):
+                telemetry.counter("reqtrace/ledger_rows_total").inc()
+            else:
+                telemetry.counter("reqtrace/ledger_dropped_total").inc()
+            if self._chrome:
+                self._emit_chrome(row)
+
+    def _finalize_locked(self, rec: dict, outcome: str, dt_ms: float,
+                         attrs: dict) -> dict:
+        row = {
+            "rid": rec["rid"], "t_wall": rec["t_wall"],
+            "seed": rec["seed"], "psi": rec["psi"],
+            "batch": rec["batch"], "outcome": outcome,
+            "cause": attrs.get("cause"), "e2e_ms": dt_ms,
+            "events": [
+                ({"kind": k, "t_ms": t} | (a or {}))
+                for k, t, a in rec["events"]],
+            "_t0": rec["t0"],
+        }
+        self._recent.append(row)
+        ledgered = (self._ledger_path is not None
+                    and self._ledger_rows < self._max_ledger_rows)
+        if ledgered:
+            self._ledger_rows += 1
+            self._buffer.append(row)
+            if len(self._buffer) >= _LEDGER_FLUSH_EVERY:
+                self._flush_locked()
+        row["_ledgered"] = ledgered
+        return row
+
+    def _emit_chrome(self, row: dict) -> None:
+        """The finalized trace as Chrome async events on the span
+        tracer's shared timeline (b/e pair enclosing per-event
+        instants, keyed by the request ID)."""
+        tracer = get_tracer()
+        tid = threading.get_ident()
+        t0 = row["_t0"]
+
+        def ev(ph: str, dt_ms: float, args: Optional[dict]) -> dict:
+            e = {"name": "request", "cat": "req", "ph": ph,
+                 "id": row["rid"], "ts": tracer.ts_us(t0 + dt_ms / 1e3),
+                 "pid": tracer.process_index, "tid": tid}
+            if args:
+                e["args"] = args
+            return e
+
+        tracer.emit(ev("b", 0.0, {"rid": row["rid"],
+                                  "seed": row["seed"]}))
+        for e in row["events"][1:-1]:
+            tracer.emit(ev("n", e["t_ms"],
+                           {k: v for k, v in e.items() if k != "t_ms"}))
+        tracer.emit(ev("e", row["e2e_ms"],
+                       {"outcome": row["outcome"],
+                        "cause": row["cause"]}))
+
+    def batch_span(self, batch: int, bucket: int, rids: List[str],
+                   t0: float, dur_s: float) -> None:
+        """The batch→requests causal link: one ``serve_batch`` complete
+        event whose args list every request ID the dispatch carried."""
+        if not self.enabled or not self._chrome:
+            return
+        tracer = get_tracer()
+        tracer.emit({"name": "serve_batch", "ph": "X",
+                     "ts": tracer.ts_us(t0),
+                     "dur": round(max(dur_s, 0.0) * 1e6, 3),
+                     "pid": tracer.process_index,
+                     "tid": threading.get_ident(),
+                     "args": {"batch": batch, "bucket": bucket,
+                              "rids": [r for r in rids if r]}})
+
+    # -- reading / flushing --------------------------------------------------
+
+    def recent(self) -> List[dict]:
+        """Finalized traces still in the in-memory ring (newest last),
+        without the private bookkeeping keys — what the chaos drill's
+        terminal-coverage assertion reads when no ledger is wired."""
+        with self._lock:
+            rows = list(self._recent)
+        return [{k: v for k, v in r.items() if not k.startswith("_")}
+                for r in rows]
+
+    def active_rids(self) -> List[str]:
+        with self._lock:
+            return list(self._active)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._buffer:
+            return
+        if self._ledger_path is not None:
+            with open(self._ledger_path, "a") as f:
+                for row in self._buffer:
+                    f.write(json.dumps(
+                        {k: v for k, v in row.items()
+                         if not k.startswith("_")}) + "\n")
+        self._buffer.clear()
+
+
+_REQTRACER = ReqTracer()
+
+
+def get_reqtracer() -> ReqTracer:
+    return _REQTRACER
+
+
+def configure_reqtrace(ledger_path: Optional[str] = None,
+                       **kw) -> ReqTracer:
+    return _REQTRACER.configure(ledger_path, **kw)
+
+
+def read_requests(path: str) -> List[dict]:
+    """``requests.jsonl`` rows, torn-line-tolerant (the crashed runs
+    are the ones worth inspecting — same policy as the trace CLI)."""
+    out: List[dict] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict):
+                out.append(row)
+    return out
+
+
+def render_timeline(row: dict) -> str:
+    """One request's event stream as an aligned text timeline (the
+    ``gansformer-telemetry requests --id`` view)."""
+    head = (f"request {row.get('rid')}  seed={row.get('seed')} "
+            f"psi={row.get('psi')}  outcome={row.get('outcome')}"
+            + (f" cause={row['cause']}" if row.get("cause") else "")
+            + (f"  batch={row['batch']}"
+               if row.get("batch") is not None else "")
+            + f"  e2e={row.get('e2e_ms')} ms")
+    lines = [head]
+    for ev in row.get("events", []):
+        extras = ", ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                           if k not in ("kind", "t_ms") and v is not None)
+        lines.append("  +{:>10.3f} ms  {:<12s}{}".format(
+            float(ev.get("t_ms", 0.0)), str(ev.get("kind")),
+            f"  ({extras})" if extras else ""))
+    return "\n".join(lines)

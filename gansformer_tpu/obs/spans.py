@@ -137,6 +137,35 @@ class Tracer:
                     if len(self._events) >= _FLUSH_EVERY:
                         self._flush_locked()
 
+    # -- pre-formed events (request tracing) --------------------------------
+
+    @property
+    def process_index(self) -> int:
+        return self._pid
+
+    def ts_us(self, t: Optional[float] = None) -> float:
+        """A ``time_fn`` timestamp (default: now) as microseconds on this
+        tracer's trace timeline (clamped at 0 — an event recorded before
+        ``configure()`` reset the origin lands at the timeline start
+        rather than producing an illegal negative ts)."""
+        t = self._time() if t is None else t
+        return round(max(t - self._origin, 0.0) * 1e6, 3)
+
+    def emit(self, event: dict) -> None:
+        """Append ONE pre-formed Chrome-trace event (async request
+        events, batch linkage spans) to the same buffered sink the span
+        events ride — merged ordering, one ``events.jsonl``.  The caller
+        owns the event shape; ``ts`` should come from ``ts_us`` so both
+        families share a timeline.  Dropped (cheaply) while no sink is
+        configured, matching the span-event policy for non-zero
+        processes."""
+        with self._lock:
+            if self._sink_path is None:
+                return
+            self._events.append(event)
+            if len(self._events) >= _FLUSH_EVERY:
+                self._flush_locked()
+
     # -- draining / flushing -----------------------------------------------
 
     def drain(self) -> Dict[str, Dict[str, float]]:

@@ -68,6 +68,19 @@ w-cache pair, plus the robustness family: ``serve/shed_total``,
 gauges ``serve/health_state`` (0 ready / 1 degraded / 2 unhealthy /
 3 closed-cleanly), ``serve/dispatcher_alive``, ``serve/queue_bound``,
 and the LoopWorker's ``serve/dispatch_heartbeat``.
+
+Request tracing (ISSUE 16): every ``Ticket`` carries a request ID
+(``obs/reqtrace``) and a lifecycle event stream — submitted/admitted at
+submit, popped/batched/wcache_hit/map_dispatch/synth/fetch along the
+dispatch path, and a terminal fulfilled/shed/expired/cancelled/failed
+with a cause.  ``Ticket._resolve`` is the one-shot funnel every
+outcome passes through, so terminal coverage is structural; the shed
+and refused-submit paths emit their terminals at the raise site.  The
+emit points are host-side dict appends only (the hot-loop-sync rule
+scans the emitter bodies), the ``serve/e2e_ms`` / ``serve/batch_ms``
+histograms carry the max-latency request ID as a prom exemplar, and
+each batch emits a ``serve_batch`` span listing the request IDs it
+carried (the batch→trace causal link in events.jsonl).
 """
 
 from __future__ import annotations
@@ -81,6 +94,7 @@ from typing import Dict, List, Optional, Set
 import numpy as np
 
 from gansformer_tpu.obs import registry as telemetry
+from gansformer_tpu.obs import reqtrace
 from gansformer_tpu.obs.spans import span
 from gansformer_tpu.serve.cache import WCache, wcache_key
 from gansformer_tpu.serve.programs import ServePrograms
@@ -131,13 +145,17 @@ class Ticket:
     is benign by construction."""
 
     __slots__ = ("seed", "psi", "label", "t_submit", "t_done", "deadline",
-                 "_event", "_image", "_error", "_state", "_lock")
+                 "rid", "_event", "_image", "_error", "_state", "_lock")
 
     def __init__(self, seed: int, psi: float, label,
                  deadline_s: Optional[float] = None):
         self.seed = int(seed)
         self.psi = float(psi)
         self.label = label
+        # request ID + the "submitted" trace event (obs/reqtrace);
+        # None while tracing is disabled — every later emit no-ops
+        self.rid = reqtrace.get_reqtracer().begin(seed=int(seed),
+                                                  psi=float(psi))
         self.t_submit = time.perf_counter()
         self.t_done: Optional[float] = None
         self.deadline = (None if deadline_s is None
@@ -165,8 +183,25 @@ class Ticket:
             self._image, self._error = image, error
             self.t_done = time.perf_counter()
         if state == "done":
+            # exemplar: the request ID rides the histogram's max, so a
+            # p99 outlier in telemetry.prom resolves to its timeline
             telemetry.histogram("serve/e2e_ms").observe(
-                (self.t_done - self.t_submit) * 1000.0)
+                (self.t_done - self.t_submit) * 1000.0,
+                exemplar=self.rid)
+        # terminal trace event with the typed cause — _resolve is the
+        # one-shot funnel every outcome passes through, so terminal
+        # coverage is structural, not per-call-site
+        rt = reqtrace.get_reqtracer()
+        if state == "done":
+            rt.event(self.rid, "fulfilled")
+        elif state == "cancelled":
+            rt.event(self.rid, "cancelled", cause="client_cancelled")
+        elif isinstance(error, Expired):
+            rt.event(self.rid, "expired", cause="deadline")
+        else:
+            rt.event(self.rid, "failed",
+                     cause=(type(error).__name__
+                            if error is not None else None))
         self._event.set()
         return True
 
@@ -276,8 +311,17 @@ class GenerationService:
                      "serve/shed_total", "serve/expired_total",
                      "serve/cancelled_total",
                      "serve/dispatcher_restarts_total",
-                     "serve/bucket_quarantined_total"):
+                     "serve/bucket_quarantined_total",
+                     # request tracing (obs/reqtrace): materialized here
+                     # so a serving prom always answers "is tracing
+                     # wired?" explicitly
+                     "reqtrace/requests_total", "reqtrace/events_total",
+                     "reqtrace/terminal_total", "reqtrace/dropped_total",
+                     "reqtrace/ledger_rows_total",
+                     "reqtrace/ledger_dropped_total"):
             telemetry.counter(name)
+        telemetry.gauge("reqtrace/enabled").set(
+            1.0 if reqtrace.get_reqtracer().enabled else 0.0)
         telemetry.gauge("serve/queue_bound").set(self._max_queue_depth)
         telemetry.gauge("serve/health_state").set(HEALTH_READY)
         telemetry.gauge("serve/queue_depth_now").set(0)
@@ -301,11 +345,14 @@ class GenerationService:
                    deadline_s if deadline_s is not None
                    else self._default_deadline_s)
         shed = False
+        rt = reqtrace.get_reqtracer()
         dropped: List[Ticket] = []
         with self._cv:
             if self._stop:
+                rt.event(t.rid, "failed", cause="ServiceClosed")
                 raise ServiceClosed("service is closed")
             if self._tripped:
+                rt.event(t.rid, "failed", cause="ServiceUnhealthy")
                 raise ServiceUnhealthy(
                     f"circuit breaker open after {self._restarts} "
                     f"dispatcher restart(s): "
@@ -327,12 +374,14 @@ class GenerationService:
                 shed = True
             else:
                 self._pending.append(t)
+                rt.event(t.rid, "admitted", depth=len(self._pending))
                 telemetry.gauge("serve/queue_depth_now").set(
                     len(self._pending))
                 self._cv.notify()
         self._settle_dropped(dropped)
         if shed:
             telemetry.counter("serve/shed_total").inc()
+            rt.event(t.rid, "shed", cause="overloaded")
             raise Overloaded(
                 f"admission queue at its bound "
                 f"({self._max_queue_depth}) — request shed")
@@ -686,6 +735,9 @@ class GenerationService:
                     self._busy_since = time.monotonic()
             self._settle_dropped(dropped)
             if batch:
+                rt = reqtrace.get_reqtracer()
+                for t in batch:
+                    rt.event(t.rid, "popped", depth=depth)
                 return batch
             # everything popped was dead — go back to waiting
 
@@ -702,6 +754,7 @@ class GenerationService:
         import jax
 
         programs, cache = self.programs, self.wcache
+        rt = reqtrace.get_reqtracer()
         gen = self._gen
         label_dim = programs.bundle.cfg.model.label_dim
         while True:
@@ -721,6 +774,9 @@ class GenerationService:
                 bucket = self._select_bucket(n)
                 fail_bucket = bucket
                 telemetry.histogram("serve/batch_fill").observe(n / bucket)
+                for t in batch:
+                    rt.event(t.rid, "batched", batch=self._batches,
+                             bucket=bucket)
                 rows: List[Optional[np.ndarray]] = [None] * n
                 miss: List[int] = []
                 for i, t in enumerate(batch):
@@ -729,6 +785,7 @@ class GenerationService:
                         miss.append(i)
                     else:
                         rows[i] = row
+                        rt.event(t.rid, "wcache_hit")
                 # a batch that will pay a lazy cold compile gets the
                 # hang watchdog's startup grace, not the steady budget
                 self._busy_cold = (
@@ -746,6 +803,8 @@ class GenerationService:
                                 n=len(miss))
                     mb = self._select_bucket(len(miss))
                     fail_bucket = mb
+                    for i in miss:
+                        rt.event(batch[i].rid, "map_dispatch", bucket=mb)
                     seeds = np.full((mb,), batch[miss[-1]].seed, np.int32)
                     seeds[:len(miss)] = [batch[i].seed for i in miss]
                     mlabel = None
@@ -772,6 +831,8 @@ class GenerationService:
                     # bucket == synth bucket here (same n).
                     ws_dev = map_misses()
                     imgs_dev = programs.synthesize(ws_dev, psi, noise)
+                    for t in batch:
+                        rt.event(t.rid, "synth", bucket=bucket)
                     with span("serve_fetch"):
                         faults.fire("serve_fetch", batch=self._batches)
                         cache_fill(np.asarray(jax.device_get(ws_dev)))
@@ -788,9 +849,13 @@ class GenerationService:
                     # bit-identical)
                     ws = np.stack(rows + [rows[-1]] * (bucket - n))
                     imgs_dev = programs.synthesize(ws, psi, noise)
+                    for t in batch:
+                        rt.event(t.rid, "synth", bucket=bucket)
                 with span("serve_fetch"):
                     faults.fire("serve_fetch", batch=self._batches)
                     imgs = np.asarray(jax.device_get(imgs_dev))
+                for t in batch:
+                    rt.event(t.rid, "fetch")
                 if gen != self._gen:
                     # superseded mid-batch (hang verdict): the
                     # supervisor already failed these tickets — don't
@@ -814,8 +879,12 @@ class GenerationService:
                         self._bucket_fails.pop(
                             self._select_bucket(len(miss)), None)
                 telemetry.counter("serve/images_total").inc(delivered)
+                batch_s = time.perf_counter() - t0
                 telemetry.histogram("serve/batch_ms").observe(
-                    (time.perf_counter() - t0) * 1000.0)
+                    batch_s * 1000.0, exemplar=batch[0].rid)
+                # the batch→requests causal link in events.jsonl
+                rt.batch_span(self._batches, bucket,
+                              [t.rid for t in batch], t0, batch_s)
                 self._finish_batch(gen)
             except BaseException as e:
                 # Attribution is exact for executables that raise at

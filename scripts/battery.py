@@ -132,12 +132,17 @@ def default_stages():
         #     the flagship compiles — without it every window would
         #     mkdtemp a fresh manifest and re-pay 6 × 30–100 s cold
         #     compiles, busting the budget before the submit window.
+        #     The per-request trace ledger ({win}/requests.jsonl) is
+        #     archived per window so the artifact's p99 / worst-request
+        #     IDs resolve to full timelines (gansformer-telemetry
+        #     requests {win} --id <rid>) long after the run.
         stage("serve_loadtest", 900, "serve_loadtest_tpu.json",
               [py, "scripts/loadtest_serve.py",
                "--preset", "ffhq256-duplex", "--init", "random",
                "--buckets", "1,4,8", "--requests", "300", "--rate", "8",
                "--duration-s", "600",
                "--manifest-dir", ".serve_manifest",
+               "--requests-out", "{win}/requests.jsonl",
                "--json-out", "{win}/serve_loadtest.json"]),
         # 6c. Serving overload/chaos drill (ISSUE 13): burst 4x the
         #     admission bound back-to-back with one injected dispatcher
@@ -149,10 +154,13 @@ def default_stages():
         #     {win}/serve_chaos.json lands); the doctor then grades the
         #     window — its serve_chaos section FAILs on hung tickets —
         #     into {win}/serve_doctor.json without gating completion.
-        #     --prom-out keeps the chaos-state prom out of 6b's
-        #     {win}/telemetry.prom (the SLO run's artifact must survive
-        #     unclobbered).  The shared persistent manifest means the
-        #     flagship compiles were already paid by 6b.
+        #     --prom-out / --requests-out keep the chaos-state prom and
+        #     trace ledger out of 6b's {win}/telemetry.prom and
+        #     {win}/requests.jsonl (the SLO run's artifacts must survive
+        #     unclobbered); the chaos artifact's trace_coverage section
+        #     asserts every hung/failed ticket reached a terminal trace
+        #     event with a cause.  The shared persistent manifest means
+        #     the flagship compiles were already paid by 6b.
         stage("serve_chaos", 600, "serve_chaos_tpu.json",
               ["sh", "-c",
                f"{py} scripts/loadtest_serve.py --chaos"
@@ -161,6 +169,7 @@ def default_stages():
                f" --burst-factor 4 --crash-at-batch 2"
                f" --manifest-dir .serve_manifest"
                f" --json-out {{win}}/serve_chaos.json"
+               f" --requests-out {{win}}/serve_chaos_requests.jsonl"
                f" --prom-out {{win}}/serve_chaos.prom; rc=$?;"
                f" {py} -m gansformer_tpu.cli.telemetry doctor {{win}}/"
                f" --json-out {{win}}/serve_doctor.json"

@@ -167,6 +167,29 @@ def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
             svc.close(timeout=grace_s)
         faults.disarm()
     wall_s = time.perf_counter() - t0
+    # Terminal trace coverage (ISSUE 16 acceptance): after close(),
+    # EVERY ticket the drill holds — hung and failed included — must
+    # have reached a terminal trace event with a cause; a rid still
+    # untraced here means a recovery path resolves tickets outside the
+    # _resolve funnel (a leak the ledger would never show).
+    from gansformer_tpu.obs import reqtrace as _reqtrace
+
+    rt = _reqtrace.get_reqtracer()
+    rids = [t.rid for t in tickets if getattr(t, "rid", None)]
+    terminal_rows = {r["rid"]: r for r in rt.recent()}
+    missing_terminal = [r for r in rids if r not in terminal_rows]
+    trace_coverage = {
+        "enabled": rt.enabled, "tickets": len(rids),
+        "terminal": sum(1 for r in rids if r in terminal_rows),
+        "missing_terminal_rids": missing_terminal,
+        "ok": not rt.enabled or not missing_terminal,
+    }
+    non_fulfilled = [
+        {"rid": t.rid, "state": t.state,
+         "outcome": (terminal_rows.get(t.rid) or {}).get("outcome"),
+         "cause": (terminal_rows.get(t.rid) or {}).get("cause")}
+        for t in tickets
+        if getattr(t, "rid", None) and t.state != "done"]
     # recovery: first successful completion AFTER the first failure
     fails = [t.t_done for t in tickets
              if t.state == "failed" and t.t_done is not None]
@@ -207,6 +230,8 @@ def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
         "dispatcher_restarts":
             reg.counter("serve/dispatcher_restarts_total").value
             - restarts0,
+        "trace_coverage": trace_coverage,
+        "non_fulfilled_requests": non_fulfilled,
         "recovery_ms": recovery_ms,
         "health": health,
         "warm_start": {k: (round(v, 3) if k == "seconds" else v)
@@ -324,6 +349,17 @@ def run_loadtest(bundle, buckets, requests, rate, duration_s,
         "synth_dispatch_total": snap["counters"].get(
             "serve/synth_dispatch_total", 0.0),
     })
+    # request-level drill-down (ISSUE 16): the slowest requests BY ID —
+    # the artifact's p99 becomes resolvable to a timeline via
+    # `gansformer-telemetry requests <dir> --id <rid>` — plus every
+    # non-fulfilled request's ID (an SLO loadtest expects zero)
+    ranked = sorted((t for t in tickets if t.state == "done"),
+                    key=lambda t: -t.latency_ms)
+    result["worst_requests"] = [
+        {"rid": getattr(t, "rid", None),
+         "latency_ms": round(t.latency_ms, 2)} for t in ranked[:5]]
+    result["non_fulfilled_rids"] = [
+        getattr(t, "rid", None) for t in tickets if t.state != "done"]
     return result
 
 
@@ -371,6 +407,15 @@ def main(argv=None) -> int:
     p.add_argument("--prom-out", default=None,
                    help="also write telemetry.prom here (default: next to "
                         "--json-out)")
+    p.add_argument("--requests-out", default=None,
+                   help="write the per-request trace ledger here "
+                        "(default: requests.jsonl next to --json-out; "
+                        "'' disables the ledger, keeping in-memory "
+                        "tracing only)")
+    p.add_argument("--no-reqtrace", action="store_true",
+                   help="disable request tracing entirely — the "
+                        "overhead-A/B switch (run once with, once "
+                        "without, compare p50)")
     args = p.parse_args(argv)
 
     from gansformer_tpu.obs import install_compile_listener
@@ -408,6 +453,22 @@ def main(argv=None) -> int:
     else:
         manifest_dir = args.manifest_dir
 
+    # request tracing: ledger beside the JSON artifact unless pointed
+    # elsewhere ('' keeps tracing but drops the file); --no-reqtrace is
+    # the overhead-A/B off switch
+    from gansformer_tpu.obs import reqtrace
+
+    if args.requests_out == "":
+        requests_out = None
+    elif args.requests_out is None:
+        requests_out = (os.path.join(
+            os.path.dirname(os.path.abspath(args.json_out)),
+            "requests.jsonl") if args.json_out else None)
+    else:
+        requests_out = args.requests_out
+    reqtrace.configure_reqtrace(requests_out,
+                                enabled=not args.no_reqtrace)
+
     buckets = tuple(int(b) for b in args.buckets.split(","))
     if args.chaos:
         result = run_chaos(
@@ -432,14 +493,19 @@ def main(argv=None) -> int:
     prom_path = args.prom_out or (
         os.path.join(os.path.dirname(os.path.abspath(args.json_out)),
                      "telemetry.prom") if args.json_out else None)
+    reqtrace.get_reqtracer().flush()
+    result["reqtrace_enabled"] = not args.no_reqtrace
     if prom_path:
         from gansformer_tpu.analysis.telemetry_schema import (
-            check_prom, check_serve_metric_families)
+            check_prom, check_requests, check_serve_metric_families)
 
         telemetry.get_registry().write_prom(prom_path)
         errors = check_prom(prom_path) + \
             check_serve_metric_families(prom_path,
                                         expect_overload=args.chaos)
+        if requests_out and not args.no_reqtrace:
+            errors += check_requests(requests_out, prom_path=prom_path)
+            result["requests_out"] = requests_out
         result["prom"] = prom_path
         result["prom_ok"] = not errors
         result["prom_errors"] = errors

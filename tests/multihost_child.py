@@ -23,6 +23,15 @@ def main() -> None:
 
     import jax
 
+    # jax's enum flags never read env vars (0.4.37: config.enum_flag has
+    # no getenv), so the spawner's JAX_CPU_COLLECTIVES_IMPLEMENTATION must
+    # be forwarded into the config by hand — without it the CPU client has
+    # no cross-process collectives and the first sharded dispatch dies with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    jax.config.update(
+        "jax_cpu_collectives_implementation",
+        os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo"))
+
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=2, process_id=pid)

@@ -289,6 +289,41 @@ def _serve_dispatch(self):
     assert run_rule("hot-loop-sync", ok) == []
 
 
+def test_hot_loop_covers_trace_emitter_bodies():
+    """ISSUE 16: the request-trace emitters run per ticket inside the
+    serve dispatch loop but live outside its ``while`` body — the rule
+    scans their FULL bodies (no loop required, no span sanctioned),
+    gated on the reqtrace module path so an unrelated ``begin``
+    elsewhere stays out of scope."""
+    bad = """
+def event(self, rid, kind, **attrs):
+    snap = jax.device_get(dev)
+    with span("serve_fetch"):
+        more = jax.block_until_ready(out)   # no span sanctions an emitter
+"""
+    findings = lint_source(bad, path="gansformer_tpu/obs/reqtrace.py",
+                           rules=[get_rule("hot-loop-sync")])
+    assert len(findings) == 2
+    assert all("trace emitter" in f.message for f in findings)
+    # the same source OUTSIDE the reqtrace module is not an emitter
+    assert lint_source(bad, path="gansformer_tpu/serve/cache.py",
+                       rules=[get_rule("hot-loop-sync")]) == []
+    # non-emitter functions in the reqtrace module stay unscanned
+    # (read-side helpers may legitimately block on IO, not the device)
+    other = """
+def read_requests(path):
+    rows = jax.device_get(dev)
+"""
+    assert lint_source(other, path="gansformer_tpu/obs/reqtrace.py",
+                       rules=[get_rule("hot-loop-sync")]) == []
+    # and the REAL emitter bodies are clean — the acceptance property
+    real = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "gansformer_tpu", "obs", "reqtrace.py")
+    with open(real) as f:
+        assert lint_source(f.read(), path=real,
+                           rules=[get_rule("hot-loop-sync")]) == []
+
+
 def test_host_sync_item_and_np_asarray_taint():
     src = """
 import jax

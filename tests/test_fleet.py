@@ -1,0 +1,223 @@
+"""Fleet telemetry aggregation tests (obs/aggregate, ISSUE 16): the
+declared merge semantics (counters sum, gauges max/min/spread, summary
+members sum/min/max), step skew agreeing with ``check_heartbeats`` by
+construction, restart asymmetry, the ``fleet.prom`` export passing the
+prom + fleet-family lints, and the degradation contract — missing
+process, stale heartbeat, torn prom, conflicting gauge timestamps all
+yield a PARTIAL view with reasons, never an exception."""
+
+import json
+import os
+
+import pytest
+
+from gansformer_tpu.analysis.telemetry_schema import (
+    check_fleet_metric_families, check_prom)
+from gansformer_tpu.obs.aggregate import (
+    aggregate_fleet, fleet_prom_text, write_fleet)
+from gansformer_tpu.obs.heartbeat import check_heartbeats
+
+NOW = 1_000_000.0
+
+PROM_P0 = """\
+# TYPE serve_requests_total counter
+serve_requests_total 100.0
+# TYPE device_mfu gauge
+device_mfu 0.30
+# TYPE data_wait_ms summary
+data_wait_ms_count 10.0
+data_wait_ms_sum 50.0
+data_wait_ms_min 1.0
+data_wait_ms_max 9.0
+"""
+
+PROM_P1 = """\
+# TYPE serve_requests_total counter
+serve_requests_total 40.0
+# TYPE device_mfu gauge
+device_mfu 0.22
+# TYPE data_wait_ms summary
+data_wait_ms_count 4.0
+data_wait_ms_sum 30.0
+data_wait_ms_min 0.5
+data_wait_ms_max 20.0
+"""
+
+
+def write_hb(d, idx, *, time=NOW - 5.0, step=4000):
+    with open(os.path.join(d, f"heartbeat-p{idx}.json"), "w") as f:
+        json.dump({"process": idx, "pid": 1, "host": "h", "time": time,
+                   "step": step, "kimg": step / 1000}, f)
+
+
+def shared_dir(tmp_path, name="run"):
+    d = tmp_path / name
+    d.mkdir()
+    write_hb(d, 0, step=4000)
+    write_hb(d, 1, step=3800)
+    (d / "telemetry-p0.prom").write_text(PROM_P0)
+    (d / "telemetry-p1.prom").write_text(PROM_P1)
+    return str(d)
+
+
+# --- merge semantics --------------------------------------------------------
+
+def test_merge_semantics_shared_dir(tmp_path):
+    d = shared_dir(tmp_path)
+    fleet = aggregate_fleet(d, expected=2, now=NOW)
+    assert not fleet["partial"], fleet["partial_reasons"]
+    assert fleet["reporting"] == [0, 1]
+    assert fleet["prom_reporting"] == [0, 1]
+    # counters SUM
+    assert fleet["counters"]["serve_requests_total"] == 140.0
+    # gauges → max/min/spread with per-process provenance
+    mfu = fleet["gauges"]["device_mfu"]
+    assert mfu["max"] == 0.30 and mfu["min"] == 0.22
+    assert mfu["spread"] == pytest.approx(0.08)
+    assert mfu["per_process"] == {"0": 0.30, "1": 0.22}
+    assert fleet["mfu_spread"] == pytest.approx(0.08)
+    # summaries: count/sum SUM, min MIN, max MAX — quantiles never invented
+    s = fleet["histograms"]["data_wait_ms"]
+    assert s == {"count": 14.0, "sum": 80.0, "min": 0.5, "max": 20.0}
+    # step skew is check_heartbeats' OWN number — same computation,
+    # cannot disagree with the heartbeats CLI / doctor verdict
+    hb = check_heartbeats(d, max_age_s=1e18, expected=[0, 1], now=NOW)
+    assert fleet["step_skew"] == hb["step_skew"] == 200
+    assert fleet["steps"] == {"0": 4000, "1": 3800}
+
+
+def test_fleet_prom_passes_both_lints(tmp_path):
+    d = shared_dir(tmp_path)
+    fleet = aggregate_fleet(d, expected=2, now=NOW)
+    _, prom_path = write_fleet(fleet, str(tmp_path / "fleet"))
+    assert check_prom(prom_path) == []
+    assert check_fleet_metric_families(prom_path) == []
+    text = open(prom_path).read()
+    # the partial marker is the FIRST sample — a reader can't miss it
+    assert text.splitlines()[1] == "fleet_partial 0.0"
+    assert "serve_requests_total 140.0" in text
+    assert "device_mfu_spread" in text
+    assert "data_wait_ms_count 14.0" in text
+
+
+def test_list_of_dirs_mode_and_restart_asymmetry(tmp_path):
+    dirs = []
+    for i, (prom, restarts) in enumerate(((PROM_P0, 3), (PROM_P1, 0))):
+        d = tmp_path / f"p{i}"
+        d.mkdir()
+        write_hb(d, i, step=1000 + i)
+        (d / "telemetry.prom").write_text(prom)
+        with open(d / "supervisor_events.jsonl", "w") as f:
+            f.write(json.dumps({"kind": "start", "time": NOW,
+                                "pid": 1}) + "\n")
+            for _ in range(restarts):
+                f.write(json.dumps({"kind": "restart", "time": NOW,
+                                    "pid": 1}) + "\n")
+        dirs.append(str(d))
+    fleet = aggregate_fleet(dirs, expected=2, now=NOW)
+    assert not fleet["partial"], fleet["partial_reasons"]
+    assert fleet["counters"]["serve_requests_total"] == 140.0
+    assert fleet["step_skew"] == 1
+    # restarts clustered on one host: total AND asymmetry are visible
+    assert fleet["restarts_total"] == 3
+    assert fleet["restart_spread"] == 3
+    _, prom_path = write_fleet(fleet, str(tmp_path / "fleet"))
+    assert "fleet_restart_spread 3.0" in open(prom_path).read()
+    assert check_prom(prom_path) == []
+
+
+# --- degradation contract: partial, with reasons, never a raise -------------
+
+def test_missing_process_degrades_to_partial(tmp_path):
+    d = shared_dir(tmp_path)
+    fleet = aggregate_fleet(d, expected=3, now=NOW)
+    assert fleet["partial"]
+    assert fleet["missing"] == [2]
+    assert any("process 2 missing" in r for r in fleet["partial_reasons"])
+    # merged numbers still present — partial degrades, it doesn't empty
+    assert fleet["counters"]["serve_requests_total"] == 140.0
+    _, prom_path = write_fleet(fleet, str(tmp_path / "fleet"))
+    text = open(prom_path).read()
+    assert "fleet_partial 1.0" in text
+    assert "fleet_processes_missing 1.0" in text
+    assert check_fleet_metric_families(prom_path) == []
+
+
+def test_stale_heartbeat_degrades_to_partial(tmp_path):
+    d = shared_dir(tmp_path)
+    write_hb(d, 1, time=NOW - 500.0, step=3800)
+    fleet = aggregate_fleet(d, expected=2, max_age_s=120.0, now=NOW)
+    assert fleet["partial"]
+    assert fleet["stale"] == [1]
+    assert any("stale" in r for r in fleet["partial_reasons"])
+    assert fleet["heartbeat_age_max_s"] == pytest.approx(500.0)
+
+
+def test_torn_prom_degrades_but_still_merges(tmp_path):
+    d = shared_dir(tmp_path)
+    with open(os.path.join(d, "telemetry-p1.prom"), "w") as f:
+        f.write("# TYPE serve_requests_total counter\n"
+                "serve_requests_total 40.0\n"
+                "device_mfu 0.22 extra garbage tokens\n")   # torn line
+    fleet = aggregate_fleet(d, expected=2, now=NOW)
+    assert fleet["partial"]
+    assert any("partially-written prom" in r
+               for r in fleet["partial_reasons"])
+    assert fleet["processes"]["1"]["prom_issues"] == 1
+    # the parsable lines of the torn file still contribute
+    assert fleet["counters"]["serve_requests_total"] == 140.0
+
+
+def test_conflicting_gauge_timestamps_flag_the_merge(tmp_path):
+    d = shared_dir(tmp_path)
+    write_hb(d, 1, time=NOW - 400.0, step=3800)   # artifacts 395s apart
+    fleet = aggregate_fleet(d, expected=2, now=NOW, gauge_skew_s=300.0)
+    assert fleet["partial"] and fleet["gauge_ts_conflict"]
+    assert any("not simultaneous" in r for r in fleet["partial_reasons"])
+    _, prom_path = write_fleet(fleet, str(tmp_path / "fleet"))
+    assert "fleet_gauge_ts_conflict 1.0" in open(prom_path).read()
+    # within the skew bound the same layout is NOT flagged
+    write_hb(d, 1, time=NOW - 100.0, step=3800)
+    ok = aggregate_fleet(d, expected=2, now=NOW, gauge_skew_s=300.0)
+    assert not ok["gauge_ts_conflict"]
+
+
+def test_empty_dir_never_raises(tmp_path):
+    d = tmp_path / "empty"
+    d.mkdir()
+    fleet = aggregate_fleet(str(d), now=NOW)
+    assert fleet["partial"]
+    assert any("no heartbeat" in r for r in fleet["partial_reasons"])
+    # the export is still a valid, lintable artifact
+    _, prom_path = write_fleet(fleet, str(tmp_path / "fleet"))
+    assert check_prom(prom_path) == []
+    assert check_fleet_metric_families(prom_path) == []
+
+
+def test_single_writer_layout_attributes_prom_to_p0(tmp_path):
+    """The train loop's layout: one telemetry.prom (process 0 owns it),
+    per-process heartbeats.  p1 having no prom is the DESIGN, not a
+    partial view."""
+    d = tmp_path / "run"
+    d.mkdir()
+    write_hb(d, 0)
+    write_hb(d, 1)
+    (d / "telemetry.prom").write_text(PROM_P0)
+    fleet = aggregate_fleet(str(d), expected=2, now=NOW)
+    assert not fleet["partial"], fleet["partial_reasons"]
+    assert fleet["prom_reporting"] == [0]
+    assert fleet["counters"]["serve_requests_total"] == 100.0
+    assert fleet["processes"]["0"]["prom"] == "telemetry.prom"
+    assert fleet["processes"]["1"]["prom"] is None
+
+
+def test_cli_fleet_writes_artifacts(tmp_path, capsys):
+    from gansformer_tpu.cli.telemetry import main as cli_main
+
+    d = shared_dir(tmp_path)
+    out = tmp_path / "out"
+    cli_main(["fleet", d, "--expected", "2", "--out-dir", str(out)])
+    assert "wrote" in capsys.readouterr().out
+    assert (out / "fleet.json").exists() and (out / "fleet.prom").exists()
+    fleet = json.load(open(out / "fleet.json"))
+    assert fleet["counters"]["serve_requests_total"] == 140.0

@@ -1,0 +1,340 @@
+"""Per-request tracing tests (obs/reqtrace, ISSUE 16): lifecycle
+recording on fake clocks, terminal finalization + ledger round-trip,
+bounded growth (active-table eviction, ledger cap — every bound has a
+counter), Chrome async events merged into the span sink, the
+``requests.jsonl`` schema checker incl. its prom cross-checks, the
+``requests`` CLI subcommand, and the disabled-path no-ops."""
+
+import json
+import os
+
+import pytest
+
+from gansformer_tpu.analysis.telemetry_schema import (
+    check_events, check_requests)
+from gansformer_tpu.obs import registry as telemetry
+from gansformer_tpu.obs.reqtrace import (
+    EVENT_KINDS, TERMINAL_KINDS, ReqTracer, read_requests, render_timeline)
+from gansformer_tpu.obs.spans import get_tracer
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_tracer(clk, wall0=1_000_000.0):
+    # wall clock rides the same fake advance so ledger rows carry
+    # deterministic t_wall values for the SLO window tests' idiom
+    return ReqTracer(time_fn=clk, wall_fn=lambda: wall0 + clk.t)
+
+
+def counter_value(name):
+    return telemetry.counter(name).value
+
+
+# --- lifecycle --------------------------------------------------------------
+
+def test_lifecycle_roundtrip_through_ledger(tmp_path):
+    clk = FakeClock()
+    rt = make_tracer(clk)
+    path = str(tmp_path / "requests.jsonl")
+    rt.configure(path, chrome_events=False)
+
+    rid = rt.begin(seed=7, psi=0.8)
+    assert rid and rid.startswith("r")
+    clk.advance(0.010)
+    rt.event(rid, "admitted")
+    clk.advance(0.005)
+    rt.event(rid, "popped")
+    rt.event(rid, "batched", batch=3, bucket=4)
+    rt.event(rid, "map_dispatch")
+    rt.event(rid, "synth")
+    clk.advance(0.020)
+    rt.event(rid, "fetch")
+    rt.event(rid, "fulfilled")
+    rt.flush()
+
+    rows = read_requests(path)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["rid"] == rid
+    assert row["outcome"] == "fulfilled" and row["cause"] is None
+    assert row["seed"] == 7 and row["psi"] == 0.8 and row["batch"] == 3
+    assert row["e2e_ms"] == pytest.approx(35.0)
+    assert [e["kind"] for e in row["events"]] == [
+        "submitted", "admitted", "popped", "batched", "map_dispatch",
+        "synth", "fetch", "fulfilled"]
+    assert row["events"][0]["t_ms"] == 0.0
+    assert row["events"][3]["bucket"] == 4           # attrs ride the event
+    # no private bookkeeping keys leak into the artifact
+    assert not any(k.startswith("_") for k in row)
+    # the artifact passes its own schema lint
+    assert check_requests(path) == []
+    # the trace left the active table and landed in the ring
+    assert rt.active_rids() == []
+    assert rt.recent()[0]["rid"] == rid
+
+
+def test_terminal_cause_and_distinct_outcomes(tmp_path):
+    clk = FakeClock()
+    rt = make_tracer(clk)
+    path = str(tmp_path / "requests.jsonl")
+    rt.configure(path, chrome_events=False)
+    outcomes = {}
+    for kind, cause in (("shed", "overloaded"), ("expired", "deadline"),
+                        ("cancelled", "client"), ("failed", "Boom")):
+        rid = rt.begin(seed=1)
+        clk.advance(0.001)
+        rt.event(rid, kind, cause=cause)
+        outcomes[rid] = (kind, cause)
+    rt.flush()
+    rows = {r["rid"]: r for r in read_requests(path)}
+    assert len(rows) == 4
+    for rid, (kind, cause) in outcomes.items():
+        assert rows[rid]["outcome"] == kind
+        assert rows[rid]["cause"] == cause
+    assert check_requests(path) == []
+
+
+def test_active_table_eviction_counts_dropped(tmp_path):
+    clk = FakeClock()
+    rt = make_tracer(clk)
+    rt.configure(None, max_active=2, chrome_events=False)
+    before = counter_value("reqtrace/dropped_total")
+    r1 = rt.begin()
+    r2 = rt.begin()
+    r3 = rt.begin()                 # evicts r1 (oldest-first)
+    assert counter_value("reqtrace/dropped_total") == before + 1
+    assert rt.active_rids() == [r2, r3]
+    # a late event against the evicted trace is ignored, never a crash
+    rt.event(r1, "fulfilled")
+    assert rt.recent() == []
+    rt.event(r2, "fulfilled")
+    rt.event(r3, "fulfilled")
+    assert [r["rid"] for r in rt.recent()] == [r2, r3]
+
+
+def test_ledger_cap_counts_dropped_rows(tmp_path):
+    clk = FakeClock()
+    rt = make_tracer(clk)
+    path = str(tmp_path / "requests.jsonl")
+    rt.configure(path, max_ledger_rows=2, chrome_events=False)
+    rows_before = counter_value("reqtrace/ledger_rows_total")
+    drop_before = counter_value("reqtrace/ledger_dropped_total")
+    for _ in range(3):
+        rid = rt.begin()
+        rt.event(rid, "fulfilled")
+    rt.flush()
+    assert len(read_requests(path)) == 2          # bound held
+    assert counter_value("reqtrace/ledger_rows_total") == rows_before + 2
+    assert counter_value("reqtrace/ledger_dropped_total") == drop_before + 1
+    assert len(rt.recent()) == 3                  # the ring still has all
+
+
+def test_disabled_tracer_is_a_noop():
+    clk = FakeClock()
+    rt = make_tracer(clk)
+    rt.configure(None, enabled=False)
+    before = counter_value("reqtrace/requests_total")
+    assert rt.begin(seed=1) is None
+    rt.event(None, "fulfilled")                   # must not raise
+    assert counter_value("reqtrace/requests_total") == before
+    assert rt.recent() == []
+    # the explicit marker: disabled is a declared state, not absence
+    assert telemetry.gauge("reqtrace/enabled").value == 0.0
+    rt.configure(None, enabled=True)
+    assert telemetry.gauge("reqtrace/enabled").value == 1.0
+
+
+# --- Chrome async events ----------------------------------------------------
+
+def test_chrome_async_events_merge_into_span_sink(tmp_path):
+    events_path = str(tmp_path / "events.jsonl")
+    tracer = get_tracer()
+    tracer.configure(events_path, process_index=0)
+    try:
+        clk = FakeClock()
+        rt = make_tracer(clk)
+        rt.configure(None)
+        rid = rt.begin(seed=3)
+        clk.advance(0.002)
+        rt.event(rid, "batched", batch=1)
+        clk.advance(0.004)
+        rt.event(rid, "fulfilled")
+        rt.batch_span(batch=1, bucket=4, rids=[rid, None], t0=clk.t,
+                      dur_s=0.004)
+        tracer.flush()
+    finally:
+        tracer.configure(None)
+    events = [json.loads(l) for l in open(events_path) if l.strip()]
+    req = [e for e in events if e.get("cat") == "req"]
+    # begin / per-event instant / end, all correlated by the request id
+    assert [e["ph"] for e in req] == ["b", "n", "e"]
+    assert all(e["id"] == rid for e in req)
+    assert req[1]["args"]["kind"] == "batched"
+    assert req[2]["args"]["outcome"] == "fulfilled"
+    batch = [e for e in events if e.get("name") == "serve_batch"]
+    assert len(batch) == 1 and batch[0]["ph"] == "X"
+    assert batch[0]["args"]["rids"] == [rid]      # None rids filtered
+    # the merged file passes the events schema (async phases included)
+    assert check_events(events_path) == []
+
+
+def test_check_events_grades_async_phases(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    base = {"name": "request", "ts": 1.0, "pid": 0, "tid": 1}
+    with open(path, "w") as f:
+        f.write(json.dumps({**base, "ph": "b", "id": "r1-1"}) + "\n")
+        f.write(json.dumps({**base, "ph": "b"}) + "\n")           # no id
+        f.write(json.dumps({**base, "ph": "X"}) + "\n")           # no dur
+        f.write(json.dumps({**base, "ph": "Z", "id": "r1-1"}) + "\n")
+    errors = check_events(path)
+    assert len(errors) == 3
+    assert any("missing 'id'" in e for e in errors)
+    assert any("missing 'dur'" in e for e in errors)
+    assert any("ph='Z'" in e for e in errors)
+
+
+# --- readers / renderers ----------------------------------------------------
+
+def test_read_requests_tolerates_torn_lines(tmp_path):
+    path = str(tmp_path / "requests.jsonl")
+    row = {"rid": "r1-1", "outcome": "fulfilled", "cause": None,
+           "e2e_ms": 5.0, "t_wall": 1.0,
+           "events": [{"kind": "submitted", "t_ms": 0.0},
+                      {"kind": "fulfilled", "t_ms": 5.0}]}
+    with open(path, "w") as f:
+        f.write(json.dumps(row) + "\n")
+        f.write(json.dumps({**row, "rid": "r1-2"}) + "\n")
+        f.write('{"rid": "r1-3", "outco')          # killed mid-append
+    assert [r["rid"] for r in read_requests(path)] == ["r1-1", "r1-2"]
+    # the schema checker tolerates ONLY the final torn line
+    assert check_requests(path) == []
+    with open(path, "w") as f:
+        f.write('{"torn mid')
+        f.write("\n" + json.dumps(row) + "\n")
+    assert any("not JSON" in e for e in check_requests(path))
+
+
+def test_check_requests_catches_schema_violations(tmp_path):
+    path = str(tmp_path / "requests.jsonl")
+    good = {"rid": "r1-1", "outcome": "fulfilled", "cause": None,
+            "e2e_ms": 5.0,
+            "events": [{"kind": "submitted", "t_ms": 0.0},
+                       {"kind": "fulfilled", "t_ms": 5.0}]}
+    bad_rows = [
+        {**good, "rid": "r1-2", "outcome": "shed", "cause": None,
+         "events": [{"kind": "submitted", "t_ms": 0.0},
+                    {"kind": "shed", "t_ms": 1.0}]},   # shed w/o cause
+        {**good, "rid": "r1-3", "outcome": "vanished"},
+        {**good, "rid": "r1-4",
+         "events": [{"kind": "submitted", "t_ms": 3.0},
+                    {"kind": "fulfilled", "t_ms": 1.0}]},  # non-monotone
+        {**good, "rid": "r1-1"},                       # duplicate rid
+    ]
+    with open(path, "w") as f:
+        for row in [good] + bad_rows:
+            f.write(json.dumps(row) + "\n")
+    errors = check_requests(path)
+    assert any("without a cause" in e for e in errors)
+    assert any("outside" in e and "vanished" in e for e in errors)
+    assert any("not monotone" in e for e in errors)
+    assert any("duplicate terminal row" in e for e in errors)
+
+
+def test_check_requests_prom_cross_checks(tmp_path):
+    path = str(tmp_path / "requests.jsonl")
+    row = {"rid": "r1-1", "outcome": "fulfilled", "cause": None,
+           "e2e_ms": 5.0,
+           "events": [{"kind": "submitted", "t_ms": 0.0},
+                      {"kind": "fulfilled", "t_ms": 5.0}]}
+    with open(path, "w") as f:
+        f.write(json.dumps(row) + "\n")
+    prom = str(tmp_path / "telemetry.prom")
+
+    def write_prom(ledgered, dropped, served):
+        with open(prom, "w") as f:
+            f.write(f"reqtrace_ledger_rows_total {ledgered}\n"
+                    f"reqtrace_ledger_dropped_total {dropped}\n"
+                    f"serve_requests_total {served}\n")
+
+    write_prom(1, 0, 1)
+    assert check_requests(path, prom_path=prom) == []
+    write_prom(5, 0, 1)                 # rows lost outside the bound
+    assert any("rows were lost" in e
+               for e in check_requests(path, prom_path=prom))
+    write_prom(5, 4, 1)                 # ...but declared overflow is fine
+    assert check_requests(path, prom_path=prom) == []
+    write_prom(1, 0, 0)                 # ledger vs prom from different runs
+    assert any("different runs" in e
+               for e in check_requests(path, prom_path=prom))
+
+
+def test_render_timeline_is_readable():
+    row = {"rid": "r9-1", "seed": 4, "psi": 0.7, "batch": 2,
+           "outcome": "failed", "cause": "Boom", "e2e_ms": 12.5,
+           "events": [{"kind": "submitted", "t_ms": 0.0},
+                      {"kind": "batched", "t_ms": 3.0, "bucket": 4},
+                      {"kind": "failed", "t_ms": 12.5, "cause": "Boom"}]}
+    text = render_timeline(row)
+    assert "r9-1" in text and "cause=Boom" in text and "batch=2" in text
+    lines = text.splitlines()
+    assert len(lines) == 4 and "bucket=4" in lines[2]
+
+
+# --- the requests CLI subcommand --------------------------------------------
+
+def test_cli_requests_summary_and_filters(tmp_path, capsys):
+    from gansformer_tpu.cli.telemetry import main as cli_main
+
+    d = tmp_path / "run"
+    d.mkdir()
+    rows = []
+    for i, e2e in enumerate((5.0, 50.0, 500.0), 1):
+        rows.append({"rid": f"r1-{i}", "outcome": "fulfilled",
+                     "cause": None, "e2e_ms": e2e, "t_wall": 1.0,
+                     "events": [{"kind": "submitted", "t_ms": 0.0},
+                                {"kind": "fulfilled", "t_ms": e2e}]})
+    rows.append({"rid": "r1-4", "outcome": "shed", "cause": "overloaded",
+                 "e2e_ms": 0.1, "t_wall": 1.0,
+                 "events": [{"kind": "submitted", "t_ms": 0.0},
+                            {"kind": "shed", "t_ms": 0.1}]})
+    with open(d / "requests.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    # an exemplar pointing at the slowest request makes the default view
+    # resolve the p99 number to a concrete timeline
+    with open(d / "telemetry.prom", "w") as f:
+        f.write("serve_e2e_ms_max 500.0\n"
+                "# EXEMPLAR serve_e2e_ms_max r1-3\n")
+
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["requests", str(d)])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    assert "fulfilled" in out and "shed" in out
+    assert "r1-3" in out                       # exemplar resolved
+
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["requests", str(d), "--id", "r1-4"])
+    assert exc.value.code == 0
+    assert "cause=overloaded" in capsys.readouterr().out
+
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["requests", str(d), "--worst", "1"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    assert "r1-3" in out and "r1-1" not in out
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["requests", str(empty)])
+    assert exc.value.code == 1                 # no ledger → exit 1

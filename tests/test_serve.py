@@ -737,7 +737,14 @@ def test_serve_schema_overload_values_awareness(tmp_path):
             "serve_cancelled_total": 0,
             "serve_dispatcher_restarts_total": 0,
             "serve_health_state": 0, "serve_dispatcher_alive": 1,
-            "serve_queue_bound": 8, "serve_queue_depth_now": 0}
+            "serve_queue_bound": 8, "serve_queue_depth_now": 0,
+            # the ISSUE 16 tracing family rides every serving prom;
+            # requests opened AND reached terminals (lifecycle leaks
+            # are a separate values-aware error)
+            "reqtrace_requests_total": 12, "reqtrace_events_total": 60,
+            "reqtrace_terminal_total": 12, "reqtrace_dropped_total": 0,
+            "reqtrace_ledger_rows_total": 12,
+            "reqtrace_ledger_dropped_total": 0, "reqtrace_enabled": 1}
 
     def write(vals, name):
         path = str(tmp_path / name)

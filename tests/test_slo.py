@@ -1,0 +1,216 @@
+"""SLO error-budget tests (obs/slo, ISSUE 16): budget/burn-rate math
+over synthetic ledgers, the rolling window, the lifetime-counter prom
+fallback (and what it declaredly cannot see), the no-data floor, the
+``slo`` CLI exit code, and the doctor's slo section — FAIL on an
+exhausted budget, informational PASS when a chaos drill spent it on
+purpose."""
+
+import json
+import os
+
+import pytest
+
+from gansformer_tpu.cli.telemetry import main as cli_main, run_doctor
+from gansformer_tpu.obs.slo import (
+    DEFAULT_OBJECTIVES, evaluate_slos, render_slos)
+
+NOW = 1_000_000.0
+
+
+def write_ledger(d, rows):
+    with open(os.path.join(d, "requests.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def row(rid, outcome, e2e_ms=10.0, t_wall=NOW - 10.0, cause=None):
+    return {"rid": rid, "outcome": outcome, "cause": cause,
+            "e2e_ms": e2e_ms, "t_wall": t_wall,
+            "events": [{"kind": "submitted", "t_ms": 0.0},
+                       {"kind": outcome, "t_ms": e2e_ms}]}
+
+
+def by_name(report):
+    return {o["name"]: o for o in report["objectives"]}
+
+
+# --- ledger math ------------------------------------------------------------
+
+def test_budget_and_burn_rate_math(tmp_path):
+    d = str(tmp_path)
+    rows = [row(f"r1-{i}", "fulfilled") for i in range(995)]
+    rows += [row(f"r1-e{i}", "expired", cause="deadline")
+             for i in range(5)]
+    write_ledger(d, rows)
+    report = evaluate_slos(d, window_s=3600.0, now=NOW)
+    assert report["source"] == "ledger" and report["rows"] == 1000
+    av = by_name(report)["availability"]
+    # target 0.999 over 1000 admitted → budget 1.0; 5 bad spends 5x
+    assert av["total"] == 1000 and av["bad"] == 5
+    assert av["budget_total"] == pytest.approx(1.0)
+    assert av["budget_spent"] == 5.0
+    assert av["budget_remaining"] == 0.0
+    assert av["burn_rate"] == pytest.approx(5.0)
+    assert av["exhausted"] and av["status"] == "exhausted"
+    assert report["exhausted"] == ["availability"]
+    assert report["worst_burn_rate"] == pytest.approx(5.0)
+    # latency: every fulfilled row under threshold → burn 0, ok
+    lat = by_name(report)["latency_p99"]
+    assert lat["total"] == 995 and lat["bad"] == 0
+    assert lat["burn_rate"] == 0.0 and not lat["exhausted"]
+    # shed: no sheds at all → ok
+    shed = by_name(report)["shed_rate"]
+    assert shed["total"] == 1000 and shed["bad"] == 0
+
+
+def test_latency_objective_counts_only_fulfilled(tmp_path):
+    d = str(tmp_path)
+    rows = [row(f"r1-{i}", "fulfilled", e2e_ms=100.0) for i in range(90)]
+    rows += [row(f"r1-s{i}", "fulfilled", e2e_ms=5000.0)
+             for i in range(10)]
+    # a shed row's tiny e2e must NOT dilute the latency distribution
+    rows += [row("r1-x", "shed", e2e_ms=0.1, cause="overloaded")]
+    write_ledger(d, rows)
+    report = evaluate_slos(d, window_s=3600.0, now=NOW)
+    lat = by_name(report)["latency_p99"]
+    assert lat["total"] == 100 and lat["bad"] == 10
+    # 10% bad over a 1% budget → burn 10
+    assert lat["burn_rate"] == pytest.approx(10.0)
+    assert lat["exhausted"]
+
+
+def test_cancelled_rows_spend_no_availability_budget(tmp_path):
+    d = str(tmp_path)
+    rows = [row(f"r1-{i}", "fulfilled") for i in range(50)]
+    rows += [row(f"r1-c{i}", "cancelled", cause="client")
+             for i in range(50)]
+    write_ledger(d, rows)
+    av = by_name(evaluate_slos(d, window_s=3600.0, now=NOW))["availability"]
+    assert av["total"] == 50 and av["bad"] == 0   # cancels excluded
+    assert not av["exhausted"]
+
+
+def test_rolling_window_excludes_old_rows(tmp_path):
+    d = str(tmp_path)
+    rows = [row(f"r1-{i}", "expired", cause="deadline",
+                t_wall=NOW - 10_000.0) for i in range(20)]
+    rows += [row("r1-new", "fulfilled", t_wall=NOW - 5.0)]
+    write_ledger(d, rows)
+    report = evaluate_slos(d, window_s=3600.0, now=NOW)
+    assert report["rows"] == 1                    # old spend aged out
+    assert report["exhausted"] == []
+
+
+# --- fallbacks --------------------------------------------------------------
+
+def test_prom_fallback_grades_what_counters_can_see(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "telemetry.prom"), "w") as f:
+        f.write("serve_requests_total 200.0\n"
+                "serve_shed_total 50.0\n"
+                "serve_expired_total 1.0\n"
+                "serve_cancelled_total 0.0\n")
+    report = evaluate_slos(d, window_s=3600.0, now=NOW)
+    assert report["source"] == "prom"
+    objs = by_name(report)
+    # counters carry no per-request latency — declared, not fabricated
+    assert objs["latency_p99"]["status"] == "no_data"
+    av = objs["availability"]
+    assert av["total"] == 200 and av["bad"] == 1
+    shed = objs["shed_rate"]
+    # 50 shed over 250 submissions against a 1% budget → exhausted
+    assert shed["total"] == 250 and shed["bad"] == 50
+    assert shed["exhausted"]
+    assert "shed_rate" in report["exhausted"]
+
+
+def test_no_artifacts_reports_no_data_never_invents(tmp_path):
+    report = evaluate_slos(str(tmp_path), window_s=3600.0, now=NOW)
+    assert report["source"] == "none"
+    assert all(o["status"] == "no_data" for o in report["objectives"])
+    assert report["exhausted"] == []
+    assert report["worst_burn_rate"] == 0.0
+    text = render_slos(report)
+    assert "no data" in text
+
+
+def test_render_marks_exhausted_budgets(tmp_path):
+    d = str(tmp_path)
+    write_ledger(d, [row(f"r1-{i}", "expired", cause="deadline")
+                     for i in range(10)])
+    text = render_slos(evaluate_slos(d, window_s=3600.0, now=NOW))
+    assert "EXHAUSTED" in text and "availability" in text
+
+
+def test_custom_objectives(tmp_path):
+    d = str(tmp_path)
+    write_ledger(d, [row("r1-1", "fulfilled", e2e_ms=900.0),
+                     row("r1-2", "fulfilled", e2e_ms=1100.0)])
+    strict = [{"name": "latency_strict", "kind": "latency",
+               "target": 0.99, "threshold_ms": 1000.0}]
+    report = evaluate_slos(d, objectives=strict, window_s=3600.0, now=NOW)
+    lat = by_name(report)["latency_strict"]
+    assert lat["bad"] == 1 and lat["exhausted"]
+    assert [o["name"] for o in report["objectives"]] == ["latency_strict"]
+    assert len(DEFAULT_OBJECTIVES) == 3           # defaults untouched
+
+
+# --- CLI + doctor -----------------------------------------------------------
+
+def test_cli_slo_exit_code_gates_on_exhaustion(tmp_path, capsys):
+    d = tmp_path / "bad"
+    d.mkdir()
+    write_ledger(str(d), [row(f"r1-{i}", "expired", cause="deadline",
+                              t_wall=NOW) for i in range(10)])
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["slo", str(d), "--window", "1e18", "--json"])
+    assert exc.value.code == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["exhausted"] == ["availability"]
+
+    ok = tmp_path / "ok"
+    ok.mkdir()
+    write_ledger(str(ok), [row(f"r1-{i}", "fulfilled", t_wall=NOW)
+                           for i in range(10)])
+    cli_main(["slo", str(ok), "--window", "1e18"])   # no exit → code 0
+    assert "EXHAUSTED" not in capsys.readouterr().out
+
+
+def test_doctor_slo_section_fails_on_exhaustion(tmp_path):
+    d = tmp_path / "run"
+    d.mkdir()
+    rows = [row(f"r1-{i}", "fulfilled") for i in range(50)]
+    rows += [row(f"r1-e{i}", "expired", cause="deadline")
+             for i in range(5)]
+    write_ledger(str(d), rows)
+    (d / "telemetry.prom").write_text("")   # minimal "is a run dir" marker
+    report = run_doctor(str(d), now=NOW)
+    slo = next(c for c in report["checks"] if c["name"] == "slo")
+    assert slo["level"] == "FAIL"
+    assert "EXHAUSTED" in slo["detail"]
+    assert not report["ok"]
+
+
+def test_doctor_slo_exhaustion_informational_under_chaos(tmp_path):
+    d = tmp_path / "run"
+    d.mkdir()
+    rows = [row(f"r1-{i}", "fulfilled") for i in range(50)]
+    rows += [row(f"r1-s{i}", "shed", cause="overloaded")
+             for i in range(20)]
+    write_ledger(str(d), rows)
+    (d / "telemetry.prom").write_text("")
+    # the drill artifact declares the spend deliberate
+    with open(d / "serve_chaos.json", "w") as f:
+        json.dump({"chaos": True, "hung_tickets": 0}, f)
+    report = run_doctor(str(d), now=NOW)
+    slo = next(c for c in report["checks"] if c["name"] == "slo")
+    assert slo["level"] == "PASS"
+    assert "chaos" in slo["detail"].lower()
+
+
+def test_doctor_slo_section_absent_for_train_only_dirs(tmp_path):
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "stats.jsonl").write_text("")
+    report = run_doctor(str(d), now=NOW)
+    assert all(c["name"] != "slo" for c in report["checks"])
