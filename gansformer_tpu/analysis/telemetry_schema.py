@@ -282,6 +282,14 @@ def check_metric_families(path: str) -> List[str]:
             errors.append(f"{path}: missing data/* robustness family "
                           f"member {name} (is the ISSUE-15 data plane "
                           f"wired?)")
+    for name in ("ops_modconv_fallback_total",
+                 "ops_modconv_fallback_shape_total",
+                 "ops_modconv_fallback_vmem_total"):
+        if name not in vals:
+            errors.append(f"{path}: missing conv-family fallback counter "
+                          f"{name} (is the ISSUE-17 dispatch seam "
+                          f"wired?) — a 0 here is the positive 'no "
+                          f"silent XLA fallback' claim")
     if vals.get("data_corrupt_records_total", 0.0) > 0:
         ledger = os.path.join(os.path.dirname(os.path.abspath(path)),
                               "data_quarantine.jsonl")

@@ -18,15 +18,20 @@ _PALLAS_EXPORTS = (
     "latent_to_grid_attention",
     "multihead_attention_pallas",
 )
-# conv_backend='pallas' kernel family (ISSUE 14) — same lazy discipline.
+# conv_backend='pallas' kernel family (ISSUE 14; row-blocking planners
+# ISSUE 17) — same lazy discipline.
 _PALLAS_CONV_EXPORTS = (
     "modulated_conv2d_pallas",
     "modconv_fits",
+    "modconv_plan",
     "resolve_conv_backend",
 )
 _PALLAS_UPFIRDN_EXPORTS = (
     "upfirdn2d_pallas",
     "upfirdn_fits",
+    "upfirdn_plan",
+    "ConvPlan",
+    "note_conv_fallback",
 )
 
 

@@ -47,13 +47,25 @@ Autodiff contract — the PR-9 layering, verbatim
   the hand-written kernels only ever differentiate the multilinear core
   ``y = d ⊙ conv(s ⊙ x, w)``.
 
-Oversized grids (a per-sample image block that cannot fit VMEM even at
-one output channel — ffhq1024's ≥512² layers) fall back to the XLA
-composite per call; ``modconv_fits`` is the static gate, and
-docs/pallas.md records the limit.  On TPU, first use runs
-``tpu_smoke_check`` (fwd AND bwd kernels, upfirdn included) and the
-CLIs fall back to ``conv_backend='xla'`` with the printed reason if
-Mosaic lowering fails — the same discipline as the attention backend.
+Row blocking (halo streaming): every launch site is planned by
+``modconv_plan`` — whole-image when the per-sample block double-buffers
+within the VMEM budget, else the LARGEST row block ``bh | h`` whose
+(bh + kh − 1)-row halo window fits for ALL THREE kernels (training
+needs fwd, dx/ds and dw on the same split).  Halo windows ride
+``pl.Unblocked`` BlockSpecs whose index maps return element offsets, so
+consecutive strips overlap by kh−1 rows with no halo copies in HBM;
+``ds`` accumulates across row strips as a revisited output and ``dw``
+extends its fp32 scratch accumulation over the (batch, rows) grid axes.
+The whole-image launch is the degenerate ``bh = h`` case of the same
+code path.  A typed ``ConvPlan`` fallback ('shape' for unimplemented
+geometries, 'vmem' when even a single row strip overflows) routes to
+the XLA composite and counts ``ops/modconv_fallback_total`` — with row
+blocking landed, no ffhq256/ffhq1024 model shape takes that branch
+(tests/test_pallas_conv.py walks them all).  On TPU, first use runs
+``tpu_smoke_check`` (fwd AND bwd kernels, upfirdn and a row-blocked
+strip included) and the CLIs fall back to ``conv_backend='xla'`` with
+the printed reason if Mosaic lowering fails — the same discipline as
+the attention backend.
 """
 
 from __future__ import annotations
@@ -72,17 +84,18 @@ from jax.experimental.pallas import tpu as pltpu  # importable on CPU builds
 from gansformer_tpu.ops.fused_bias_act import ACTIVATIONS, fused_bias_act
 from gansformer_tpu.ops.modulated_conv import (_conv, _conv_transpose_poly,
                                                modulated_conv2d)
-from gansformer_tpu.ops.pallas_upfirdn import (upfirdn_fits, upfirdn2d_pallas)
+from gansformer_tpu.ops.pallas_upfirdn import (ConvPlan, _divisors_desc,
+                                               note_conv_fallback,
+                                               upfirdn_fits, upfirdn2d_pallas)
 from gansformer_tpu.ops.upfirdn2d import filter_2d, setup_filter
 
-# Per-invocation VMEM budget.  The whole-image per-sample block is
-# double-buffered by the pipeline, so the fit rule below charges fixed
-# (unblocked) inputs TWICE against this.  Grids whose blocks cannot fit
-# even at one output channel (ffhq1024's ≥512² layers; the flagship's
-# 256² dense convs at bf16) fall back to the XLA composite per call —
-# the honest limit docs/pallas.md records (halo row-blocking is the
-# named follow-up); the channel-blocked upfirdn kernels have no fixed
-# block and cover every grid.
+# Per-invocation VMEM budget.  The per-sample image (or row-strip)
+# block is double-buffered by the pipeline, so the fit rule below
+# charges fixed (channel-unblocked) inputs TWICE against this.  Read at
+# call time (tests shrink it to force row plans on small grids).
+# ``modconv_plan`` shrinks the row block before `_fit_blocks` shrinks
+# the channel block, so every grid the kernels implement is covered —
+# a vmem fallback means a SINGLE row strip overflows.
 _VMEM_BUDGET = 14 * 2**20
 
 # Supported fused epilogues and their inverses (for the backward's
@@ -272,17 +285,28 @@ def _bwd_body(dy_ref, w_ref, pre_ref, post_ref, x_ref, dx_ref, ds_ref, *,
         wt = (w_ref[t].astype(jnp.float32) * pre[:, None]).astype(dy.dtype)
         u = u + lax.dot(dt, wt, precision=precision,
                         preferred_element_type=jnp.float32)
-    # dx = s ⊙ u; ds = Σ_hw x ⊙ u — one pass, two outputs.
+    # dx = s ⊙ u; ds = Σ_hw x ⊙ u — one pass, two outputs.  ds is a
+    # REVISITED output over the innermost row-strip grid axis (its index
+    # map ignores r, so the block stays resident): zero it on the first
+    # strip, accumulate the strip partial on every one.
     dx_ref[0] = (u * post[None, :]).reshape(oh, ow, cb).astype(dx_ref.dtype)
     x = x_ref[0].reshape(oh * ow, cb).astype(jnp.float32)
-    ds_ref[0] = jnp.sum(x * u, axis=0)
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        ds_ref[0] = jnp.zeros_like(ds_ref[0])
+
+    ds_ref[0] += jnp.sum(x * u, axis=0)
 
 
 def _dw_body(x_ref, dy_ref, pre_ref, post_ref, dw_ref, acc_ref, *, offs,
              oh, ow, precision):
-    i = pl.program_id(1)                 # batch index (fastest grid axis)
+    # Accumulation spans the (batch, row-strip) grid axes — both iterate
+    # inside one output-channel block (the out spec ignores i and r).
+    i = pl.program_id(1)                 # batch index
+    r = pl.program_id(2)                 # row strip (fastest grid axis)
 
-    @pl.when(i == 0)
+    @pl.when((i == 0) & (r == 0))
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -303,7 +327,7 @@ def _dw_body(x_ref, dy_ref, pre_ref, post_ref, dw_ref, acc_ref, *, offs,
             xt, dy, dimension_numbers=(((0,), (0,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
 
-    @pl.when(i == pl.num_programs(1) - 1)
+    @pl.when((i == pl.num_programs(1) - 1) & (r == pl.num_programs(2) - 1))
     def _emit():
         dw_ref[:] = acc_ref[:].astype(dw_ref.dtype)
 
@@ -325,154 +349,215 @@ def _itemsize(dt):
 
 
 def _fwd_call(x, wstack, pre, post, b, *, offs, pads, phases, act,
-              alpha, gain, interpret):
+              alpha, gain, rows, interpret):
     n, h, w, ci = x.shape
     t, _, cok_full = wstack.shape
     co = cok_full // phases
     oh, ow = h, w
     up = 2 if phases == 4 else 1
     xp = _pad_hw(x, pads)
-    hp, wp = xp.shape[1], xp.shape[2]
+    wp = xp.shape[2]
     it = _itemsize(x.dtype)
-    fixed = hp * wp * ci * it
-    per_cb = phases * (oh * ow * (4 + it)                # accumulator + out
+    # Row-strip launch; whole-image is the degenerate bh = oh case.  The
+    # halo window (bh + row pads) enters through an Unblocked spec whose
+    # index map returns ELEMENT offsets, so consecutive strips overlap.
+    bh = oh if rows is None else rows
+    assert oh % bh == 0, (oh, bh)
+    nb = oh // bh
+    prow = pads[0][0] + pads[0][1]
+    win = bh + prow
+    fixed = win * wp * ci * it
+    per_cb = phases * (bh * ow * (4 + it)                # accumulator + out
                        + t * ci * (4 + it))              # weight tile + copy
     cb = _fit_blocks(co, per_cb, fixed)
-    assert cb is not None, "caller must gate on modconv_fits()"
+    assert cb is not None, "caller must gate on modconv_plan()"
     cbk = cb * phases
     kern = functools.partial(
-        _fwd_body, offs=offs, oh=oh, ow=ow, phases=phases, act=act,
+        _fwd_body, offs=offs, oh=bh, ow=ow, phases=phases, act=act,
         alpha=alpha, gain=gain, precision=_precision(x.dtype))
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((n, up * oh, up * ow, co), x.dtype),
-        grid=(n, co // cb),
+        grid=(n, co // cb, nb),
         in_specs=[
-            pl.BlockSpec((1, hp, wp, ci), lambda i, j: (i, 0, 0, 0),
+            pl.BlockSpec((1, win, wp, ci), lambda i, j, r: (i, r * bh, 0, 0),
+                         indexing_mode=pl.Unblocked(),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((t, ci, cbk), lambda i, j: (0, 0, j),
+            pl.BlockSpec((t, ci, cbk), lambda i, j, r: (0, 0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, ci), lambda i, j: (i, 0),
+            pl.BlockSpec((1, ci), lambda i, j, r: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, cbk), lambda i, j: (i, j),
+            pl.BlockSpec((1, cbk), lambda i, j, r: (i, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, cb), lambda i, j: (0, j),
+            pl.BlockSpec((1, cb), lambda i, j, r: (0, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, up * oh, up * ow, cb),
-                               lambda i, j: (i, 0, 0, j),
+        out_specs=pl.BlockSpec((1, up * bh, up * ow, cb),
+                               lambda i, j, r: (i, r, 0, j),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(xp, wstack, pre, post, b.reshape(1, co))
 
 
-def _bwd_call(du4, wT, pre, post, x, *, offs, pads, interpret):
+def _bwd_call(du4, wT, pre, post, x, *, offs, pads, rows, interpret):
     """dx/ds of the core at cotangent ``du4`` (phase-folded for poly):
     the transposed conv through the generic kernel.  ``pre`` = demod d
-    (over the adjoint's in-channels), ``post`` = style s (over Ci)."""
+    (over the adjoint's in-channels), ``post`` = style s (over Ci).
+    Row strips stream the padded cotangent through a halo window; the
+    ``ds`` output is revisited across the row axis (see ``_bwd_body``)."""
     n, h, w, ci = x.shape
     t, cok, _ = wT.shape
     dup = _pad_hw(du4, pads)
-    hp, wp = dup.shape[1], dup.shape[2]
+    wp = dup.shape[2]
     it = _itemsize(x.dtype)
-    fixed = hp * wp * cok * it
-    per_cb = h * w * (4 + 2 * it) + t * cok * (4 + it)
+    bh = h if rows is None else rows
+    assert h % bh == 0, (h, bh)
+    nb = h // bh
+    prow = pads[0][0] + pads[0][1]
+    win = bh + prow
+    fixed = win * wp * cok * it
+    per_cb = bh * w * (4 + 2 * it) + t * cok * (4 + it)
     cb = _fit_blocks(ci, per_cb, fixed)
-    assert cb is not None, "caller must gate on modconv_fits()"
-    kern = functools.partial(_bwd_body, offs=offs, oh=h, ow=w,
+    assert cb is not None, "caller must gate on modconv_plan()"
+    kern = functools.partial(_bwd_body, offs=offs, oh=bh, ow=w,
                              precision=_precision(x.dtype))
     dx, ds = pl.pallas_call(
         kern,
         out_shape=(jax.ShapeDtypeStruct((n, h, w, ci), x.dtype),
                    jax.ShapeDtypeStruct((n, ci), jnp.float32)),
-        grid=(n, ci // cb),
+        grid=(n, ci // cb, nb),
         in_specs=[
-            pl.BlockSpec((1, hp, wp, cok), lambda i, j: (i, 0, 0, 0),
+            pl.BlockSpec((1, win, wp, cok), lambda i, j, r: (i, r * bh, 0, 0),
+                         indexing_mode=pl.Unblocked(),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((t, cok, cb), lambda i, j: (0, 0, j),
+            pl.BlockSpec((t, cok, cb), lambda i, j, r: (0, 0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, cok), lambda i, j: (i, 0),
+            pl.BlockSpec((1, cok), lambda i, j, r: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, cb), lambda i, j: (i, j),
+            pl.BlockSpec((1, cb), lambda i, j, r: (i, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h, w, cb), lambda i, j: (i, 0, 0, j),
+            pl.BlockSpec((1, bh, w, cb), lambda i, j, r: (i, r, 0, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=(pl.BlockSpec((1, h, w, cb), lambda i, j: (i, 0, 0, j),
+        out_specs=(pl.BlockSpec((1, bh, w, cb), lambda i, j, r: (i, r, 0, j),
                                 memory_space=pltpu.VMEM),
-                   pl.BlockSpec((1, cb), lambda i, j: (i, j),
+                   pl.BlockSpec((1, cb), lambda i, j, r: (i, j),
                                 memory_space=pltpu.VMEM)),
         interpret=interpret,
     )(dup, wT, pre, post, x)
     return dx, ds
 
 
-def _dw_call(x, du4, pre, post, *, offs, pads, t, interpret, out_dtype):
-    """dw of the core: per-tap [Ci, CoK] accumulation across the batch
-    grid axis in fp32 VMEM scratch (emitted at the last batch step)."""
+def _dw_call(x, du4, pre, post, *, offs, pads, t, rows, interpret,
+             out_dtype):
+    """dw of the core: per-tap [Ci, CoK] accumulation across the
+    (batch, row-strip) grid axes in fp32 VMEM scratch (emitted at the
+    last batch × last strip step)."""
     n, h, w, ci = x.shape
     cok = du4.shape[-1]
     xp = _pad_hw(x, pads)
-    hp, wp = xp.shape[1], xp.shape[2]
+    wp = xp.shape[2]
     it = _itemsize(x.dtype)
-    fixed = hp * wp * ci * it
-    per_cb = h * w * it + t * ci * 8                     # dy + acc/out
+    bh = h if rows is None else rows
+    assert h % bh == 0, (h, bh)
+    nb = h // bh
+    prow = pads[0][0] + pads[0][1]
+    win = bh + prow
+    fixed = win * wp * ci * it
+    per_cb = bh * w * it + t * ci * 8                    # dy + acc/out
     cb = _fit_blocks(cok, per_cb, fixed)
-    assert cb is not None, "caller must gate on modconv_fits()"
-    kern = functools.partial(_dw_body, offs=offs, oh=h, ow=w,
+    assert cb is not None, "caller must gate on modconv_plan()"
+    kern = functools.partial(_dw_body, offs=offs, oh=bh, ow=w,
                              precision=_precision(x.dtype))
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((t, ci, cok), out_dtype),
-        grid=(cok // cb, n),
+        grid=(cok // cb, n, nb),
         in_specs=[
-            pl.BlockSpec((1, hp, wp, ci), lambda j, i: (i, 0, 0, 0),
+            pl.BlockSpec((1, win, wp, ci), lambda j, i, r: (i, r * bh, 0, 0),
+                         indexing_mode=pl.Unblocked(),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h, w, cb), lambda j, i: (i, 0, 0, j),
+            pl.BlockSpec((1, bh, w, cb), lambda j, i, r: (i, r, 0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, ci), lambda j, i: (i, 0),
+            pl.BlockSpec((1, ci), lambda j, i, r: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, cb), lambda j, i: (i, j),
+            pl.BlockSpec((1, cb), lambda j, i, r: (i, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((t, ci, cb), lambda j, i: (0, 0, j),
+        out_specs=pl.BlockSpec((t, ci, cb), lambda j, i, r: (0, 0, j),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((t, ci, cb), jnp.float32)],
         interpret=interpret,
     )(xp, du4, pre, post)
 
 
-def modconv_fits(x_shape: Tuple[int, ...], w_shape: Tuple[int, ...],
-                 up: int = 1, itemsize: int = 4) -> bool:
-    """Static VMEM-fit gate for the kernel family at these shapes (the
-    fwd AND both backward kernels must fit at one output channel —
-    training needs all three; fixed whole-image blocks count twice for
-    the pipeline's double buffering).  False → the dispatcher falls
-    back to the XLA composite for this call (docs/pallas.md records the
-    limit)."""
+def _family_checks(x_shape: Tuple[int, ...], w_shape: Tuple[int, ...],
+                   up: int, itemsize: int, bh: int):
+    """(fixed, per_cb) VMEM charges of the three kernels at row block
+    ``bh`` — the SAME formulas the launch wrappers use, so the planner,
+    the fit tests and bench attribution can't drift from the kernels.
+    ``bh = h`` is the whole-image launch."""
     _, h, w, ci = x_shape
     kh = w_shape[0]
     co = w_shape[3]
     phases = 4 if up == 2 else 1
     t = 4 if up == 2 else kh * kh
     it = itemsize
-    hp, wp = h + kh - 1, w + kh - 1
     cok = co * phases
-    # adjoint input: SAME-padded dy (same kinds) or the space-to-depth
-    # fold of the 2H×2W cotangent, left-padded (poly)
-    bwd_fixed = ((h + 1) * (w + 1) * cok * it if up == 2
-                 else hp * wp * cok * it)
-    checks = [
-        # fwd: x block + one-channel accumulator/weights/output
-        (hp * wp * ci * it,
-         phases * (h * w * (4 + it) + t * ci * (4 + it))),
-        # bwd dx/ds: full adjoint input (CoK channels) + one-ci-channel
-        (bwd_fixed, h * w * (4 + 2 * it) + t * cok * (4 + it)),
-        # dw: x block + one-channel dy/acc (scales factor out — no
+    if up == 2:
+        # poly fwd pads ((0,1),(0,1)); poly adjoint (the space-to-depth
+        # fold of the 2H×2W cotangent) pads ((1,0),(1,0))
+        prow_f = prow_a = 1
+        wp = wpa = w + 1
+    else:
+        prow_f = prow_a = kh - 1
+        wp = wpa = w + kh - 1
+    return [
+        # fwd: x halo window + one-channel accumulator/weights/output
+        ((bh + prow_f) * wp * ci * it,
+         phases * (bh * w * (4 + it) + t * ci * (4 + it))),
+        # bwd dx/ds: adjoint-input halo window (CoK channels) +
+        # one-ci-channel strip
+        ((bh + prow_a) * wpa * cok * it,
+         bh * w * (4 + 2 * it) + t * cok * (4 + it)),
+        # dw: x halo window + one-channel dy/acc (scales factor out — no
         # modulated image copy, see _dw_body)
-        (hp * wp * ci * it, h * w * it + t * ci * 8),
+        ((bh + prow_f) * wp * ci * it, bh * w * it + t * ci * 8),
     ]
-    return all(2 * fixed + per <= _VMEM_BUDGET for fixed, per in checks)
+
+
+def modconv_plan(x_shape: Tuple[int, ...], w_shape: Tuple[int, ...],
+                 up: int = 1, itemsize: int = 4,
+                 down: int = 1) -> ConvPlan:
+    """Static launch plan for the kernel family at these shapes.
+
+    'shape' fallback for geometries the kernels don't implement
+    (down-sampling, kernels other than 1×1/3×3, up∉{1,2}); otherwise
+    the LARGEST row block ``bh | h`` whose halo windows double-buffer
+    within the budget for ALL THREE kernels (training needs fwd, dx/ds
+    and dw on the same split) — 'whole' when ``bh = h`` fits, 'rows'
+    below that, and a 'vmem' fallback only when even a single-row strip
+    overflows.  Shared by the dispatcher, the fit tests and bench
+    attribution."""
+    kh, kw = int(w_shape[0]), int(w_shape[1])
+    if not (down == 1 and kh == kw
+            and ((up == 1 and kh in (1, 3)) or (up == 2 and kh == 3))):
+        return ConvPlan("fallback", cause="shape")
+    h = x_shape[1]
+    for bh in _divisors_desc(h):
+        if all(2 * fixed + per <= _VMEM_BUDGET
+               for fixed, per in _family_checks(x_shape, w_shape, up,
+                                                itemsize, bh)):
+            return (ConvPlan("whole") if bh == h
+                    else ConvPlan("rows", rows=bh))
+    return ConvPlan("fallback", cause="vmem")
+
+
+def modconv_fits(x_shape: Tuple[int, ...], w_shape: Tuple[int, ...],
+                 up: int = 1, itemsize: int = 4) -> bool:
+    """Compat shim over ``modconv_plan`` — True iff the family covers
+    the shape (whole-image or row-blocked)."""
+    return modconv_plan(x_shape, w_shape, up, itemsize).ok
 
 
 # --------------------------------------------------------------------------
@@ -508,17 +593,17 @@ def _ref_core_grads(x, w, s, d, du, kind):
 
 @functools.partial(jax.custom_jvp, nondiff_argnums=(5, 6))
 def _mc_fwd(x, w, s, d, b, spec, interpret):
-    kind, act, alpha, gain = spec
+    kind, act, alpha, gain, rows = spec
     offs, pads, phases, wstack = _prep(kind, w)
     post = jnp.repeat(d, 4, axis=1) if kind == "poly" else d
     return _fwd_call(x, wstack, s, post, b, offs=offs, pads=pads,
                      phases=phases, act=act, alpha=alpha, gain=gain,
-                     interpret=interpret)
+                     rows=rows, interpret=interpret)
 
 
 @_mc_fwd.defjvp
 def _mc_fwd_jvp(spec, interpret, primals, tangents):
-    kind, act, alpha, gain = spec
+    kind, act, alpha, gain, _ = spec
     out = _mc_fwd(*primals, spec, interpret)
     _, tan = jax.jvp(
         lambda x, w, s, d, b: _ref_full(x, w, s, d, b, kind, act, alpha,
@@ -527,8 +612,8 @@ def _mc_fwd_jvp(spec, interpret, primals, tangents):
     return out, tan
 
 
-@functools.partial(jax.custom_jvp, nondiff_argnums=(5, 6))
-def _mc_grads(x, w, s, d, du, kind, interpret):
+@functools.partial(jax.custom_jvp, nondiff_argnums=(5, 6, 7))
+def _mc_grads(x, w, s, d, du, kind, rows, interpret):
     offs_a, pads_a, wT = _prep_adjoint(kind, w)
     offs_f, pads_f, _ = _geom(kind)
     if kind == "poly":
@@ -537,10 +622,10 @@ def _mc_grads(x, w, s, d, du, kind, interpret):
     else:
         du4, pre = du, d
     dx, ds = _bwd_call(du4, wT, pre, s, x, offs=offs_a, pads=pads_a,
-                       interpret=interpret)
+                       rows=rows, interpret=interpret)
     t = len(offs_f)
     dwt = _dw_call(x, du4, s, pre, offs=offs_f, pads=pads_f, t=t,
-                   interpret=interpret, out_dtype=jnp.float32)
+                   rows=rows, interpret=interpret, out_dtype=jnp.float32)
     if kind == "poly":
         dw = _poly_dw_fold(dwt, x.shape[-1], w.shape[3])
     else:
@@ -549,8 +634,8 @@ def _mc_grads(x, w, s, d, du, kind, interpret):
 
 
 @_mc_grads.defjvp
-def _mc_grads_jvp(kind, interpret, primals, tangents):
-    out = _mc_grads(*primals, kind, interpret)
+def _mc_grads_jvp(kind, rows, interpret, primals, tangents):
+    out = _mc_grads(*primals, kind, rows, interpret)
     _, tan = jax.jvp(
         lambda x, w, s, d, du: _ref_core_grads(x, w, s, d, du, kind),
         primals, tangents)
@@ -568,7 +653,7 @@ def _mc_core_fwd(x, w, s, d, b, spec, interpret):
 
 
 def _mc_core_bwd(spec, interpret, res, ct):
-    kind, act, alpha, gain = spec
+    kind, act, alpha, gain, rows = spec
     x, w, s, d, b, y = res
     y32 = y.astype(jnp.float32)
     ct32 = ct.astype(jnp.float32)
@@ -584,7 +669,7 @@ def _mc_core_bwd(spec, interpret, res, ct):
     # saved output (c = y_core = d ⊙ conv), so no recompute pass.
     dd = (jnp.sum(du32 * c, axis=(1, 2))
           / d.astype(jnp.float32)).astype(d.dtype)
-    dx, dw, ds = _mc_grads(x, w, s, d, du32.astype(ct.dtype), kind,
+    dx, dw, ds = _mc_grads(x, w, s, d, du32.astype(ct.dtype), kind, rows,
                            interpret)
     return dx, dw, ds.astype(s.dtype), dd, db
 
@@ -611,15 +696,21 @@ def modulated_conv2d_pallas(
     act: Optional[str] = None,
     alpha: float = 0.2,
     gain: Optional[float] = None,
+    block_rows: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Fused modulate→conv→demodulate through the Pallas kernel family,
     with an optional fused ``act(y + bias) * gain`` epilogue.
 
     Same math as ``modulated_conv2d`` (+ ``fused_bias_act`` when the
-    epilogue is passed); differentiable to second order.  Unsupported
-    geometries (down-sampling, kernels other than 1×1/3×3, up≠{1,2}) and
-    VMEM-oversized grids fall back to the XLA composite per call.
+    epilogue is passed); differentiable to second order.  Launches are
+    planned by ``modconv_plan`` (whole-image or halo row strips);
+    unsupported geometries (down-sampling, kernels other than 1×1/3×3,
+    up∉{1,2}) and grids where even a single row strip overflows VMEM
+    fall back to the XLA composite per call, counting
+    ``ops/modconv_fallback_total`` by cause.  ``block_rows`` overrides
+    the planned row block for the whole kernel family — a test hook for
+    blocked-vs-whole parity, not a tuning surface.
     """
     assert x.ndim == 4 and w.ndim == 4 and styles.ndim == 2
     n, _, _, cin = x.shape
@@ -637,19 +728,21 @@ def modulated_conv2d_pallas(
     if act is not None and act not in _FUSED_ACTS:
         y = modulated_conv2d_pallas(
             x, w, styles, demodulate=demodulate, up=up, down=down,
-            resample_filter=resample_filter, eps=eps, interpret=interpret)
+            resample_filter=resample_filter, eps=eps,
+            block_rows=block_rows, interpret=interpret)
         return fused_bias_act(y, bias, act=act, alpha=alpha, gain=gain)
-    supported = (down == 1 and kh == kw
-                 and ((up == 1 and kh in (1, 3)) or (up == 2 and kh == 3))
-                 and modconv_fits(x.shape, w.shape, up,
-                                  jnp.dtype(x.dtype).itemsize))
-    if not supported:
+    plan = modconv_plan(x.shape, w.shape, up, jnp.dtype(x.dtype).itemsize,
+                        down=down)
+    if not plan.ok:
+        note_conv_fallback(plan.cause)
         y = modulated_conv2d(x, w, styles, demodulate=demodulate, up=up,
                              down=down, resample_filter=resample_filter,
                              eps=eps)
         if act is not None:
             y = fused_bias_act(y, bias, act=act, alpha=alpha, gain=gain)
         return y
+    rows = (plan.rows if block_rows is None
+            else (block_rows if block_rows < x.shape[1] else None))
 
     # Demod coefficients by the SAME differentiable fp32 einsum as the
     # XLA path — passed as a traced arg so the custom rules only handle
@@ -669,7 +762,7 @@ def modulated_conv2d_pallas(
 
     if up == 1:
         kind = "same1" if kh == 1 else "same3"
-        spec = (kind, act, alpha, float(g))
+        spec = (kind, act, alpha, float(g), rows)
         return _mc_core(x, w, s32, d, b32, spec, interpret)
 
     # up == 2: fused polyphase + depth-to-space kernel, demod folded,
@@ -677,13 +770,14 @@ def modulated_conv2d_pallas(
     # kernel — the full XLA chain `_conv_transpose_poly → reshape →
     # filter_2d → fused_bias_act` as kernels end to end.
     y = _mc_core(x, w, s32, d, jnp.zeros((co,), jnp.float32),
-                 ("poly", None, alpha, 1.0), interpret)
+                 ("poly", None, alpha, 1.0, rows), interpret)
     f = setup_filter(resample_filter, gain=float(up * up))
     p = f.shape[0] - 1
     pad4 = ((p + 1) // 2, p // 2, (p + 1) // 2, p // 2)
     if upfirdn_fits(y.shape, f.shape, 1, 1, pad4):
         return upfirdn2d_pallas(y, f, pad=pad4, bias=bias, act=act,
                                 alpha=alpha, gain=gain, interpret=interpret)
+    note_conv_fallback("vmem")
     y = filter_2d(y, resample_filter, gain=float(up * up))
     if act is not None:
         y = fused_bias_act(y, bias, act=act, alpha=alpha, gain=gain)
@@ -735,10 +829,24 @@ def tpu_smoke_check(atol: float = 1e-2) -> tuple:
         ref_u = _ufd_xla(x, f, up=2, pad=(2, 1))
         got_u = upfirdn2d_pallas(x, f, up=2, pad=(2, 1), interpret=False)
         diffs.append(float(jnp.max(jnp.abs(got_u - ref_u))))
+        # Row-blocked strips (the Unblocked halo windows) must also
+        # lower natively — exercise fwd + bwd on a forced 4-row plan.
+        ref_r = modulated_conv2d(x, w, s, up=1)
+        got_r = modulated_conv2d_pallas(x, w, s, up=1, block_rows=4,
+                                        interpret=False)
+        diffs.append(float(jnp.max(jnp.abs(got_r - ref_r))))
+        g_ref = jax.grad(lambda x_: jnp.sum(jnp.square(
+            modulated_conv2d(x_, w, s, up=1))))(x)
+        g_got = jax.grad(lambda x_: jnp.sum(jnp.square(
+            modulated_conv2d_pallas(x_, w, s, up=1, block_rows=4,
+                                    interpret=False))))(x)
+        diffs.append(float(jnp.max(jnp.abs(g_got - g_ref))))
         ok = max(diffs) < atol
         detail = (f"max_abs_diff modconv fwd/bwd up1={diffs[0]:.2e}/"
                   f"{diffs[1]:.2e} up2={diffs[2]:.2e}/{diffs[3]:.2e} "
-                  f"upfirdn={diffs[4]:.2e} (atol {atol:g})")
+                  f"upfirdn={diffs[4]:.2e} "
+                  f"rowblock fwd/bwd={diffs[5]:.2e}/{diffs[6]:.2e} "
+                  f"(atol {atol:g})")
     except Exception as e:  # Mosaic compile failures surface as many types
         ok = False
         detail = f"native compile/run failed: {type(e).__name__}: {e}"[:400]

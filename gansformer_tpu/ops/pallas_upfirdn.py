@@ -3,14 +3,28 @@ kernel, differentiable to second order (``conv_backend='pallas'``).
 
 The XLA path (``ops/upfirdn2d.py``) lowers the whole op to one
 ``conv_general_dilated``; this module is the hand-scheduled alternative
-for the same semantics: per (batch, channel-block) grid step the kernel
-loads one image block into VMEM, performs zero-insertion + padding +
-cropping with a single ``lax.pad`` (interior dilation = the upsample,
-negative edges = the crop), walks the FIR taps as strided VMEM slices
-accumulated in fp32, and writes the decimated result — the padded
-intermediate and the pre-decimation grid never touch HBM.  The filter is
-a static compile-time constant (it always is in this codebase: blur
-taps from ``setup_filter``), so the tap loop fully unrolls.
+for the same semantics: per grid step the kernel loads one image block
+into VMEM, performs zero-insertion + padding + cropping with a single
+``lax.pad`` (interior dilation = the upsample, negative edges = the
+crop), walks the FIR taps as strided VMEM slices accumulated in fp32,
+and writes the decimated result — the padded intermediate and the
+pre-decimation grid never touch HBM.  The filter is a static
+compile-time constant (it always is in this codebase: blur taps from
+``setup_filter``), so the tap loop fully unrolls.
+
+Row blocking (halo streaming): when a whole image does not fit the VMEM
+budget, ``upfirdn_plan`` tiles the OUTPUT row axis into ``bh``-row
+strips.  Each strip reads an input row window through an
+``pl.Unblocked`` BlockSpec whose index map returns element offsets, so
+consecutive windows OVERLAP by the filter halo — no halo copies in HBM,
+no extra specs.  The row algebra (``_row_geometry``): an output strip of
+``bh`` rows spans ``we = (bh-1)*down + fh`` rows of the padded
+zero-inserted grid; pre-padding the input with ``pa0 = ceil(py0/up)``
+rows (negative = top crop) makes every window start at input row
+``r*q`` with ``q = bh*down/up`` (alignment ``up | bh*down``), with a
+constant phase residual ``c0 = pa0*up - py0 in [0, up)`` consumed
+in-kernel as the tap start offset.  Whole-image mode is the ``bh = oh``
+degenerate case of the same body.
 
 Optional fused epilogue: ``act(y + bias) * gain`` (linear/lrelu) rides
 the same kernel — the `_conv_transpose_poly → blur → fused_bias_act`
@@ -22,7 +36,8 @@ Autodiff contract (the PR-9 pattern, ``ops/pallas_attention.py``):
   the flipped filter, ``up``/``down`` swapped, and the reference's
   gradient padding (the custom TF gradient of
   ``src/dnnlib/tflib/ops/upfirdn_2d.py``).  The outer ``jax.custom_vjp``
-  therefore runs the SAME forward kernel for the backward pass.
+  therefore runs the SAME forward kernel for the backward pass — with
+  its OWN row plan (``grows``), since the adjoint's geometry differs.
 * The kernel composite is a ``jax.custom_jvp`` function whose rule
   computes the primal via the kernel (decorated recursion peels one
   transform level) and the tangent via the jnp/XLA reference — plain
@@ -31,6 +46,12 @@ Autodiff contract (the PR-9 pattern, ``ops/pallas_attention.py``):
 * The filter is non-differentiable (a static resampling constant, as in
   the reference); ``bias`` is differentiable through saved-output
   activation recovery (lrelu is invertible given the sign).
+
+This module is also the home of the conv family's shared planning
+vocabulary: ``ConvPlan`` (typed whole/rows/fallback verdict, used by
+``modconv_plan`` in ops/pallas_modconv.py as well) and
+``note_conv_fallback`` (the dispatch seam's fallback counters) live
+here because this is the lowest module in the conv import chain.
 
 Tests run the kernels in interpret mode on CPU against the XLA op and
 the numpy oracle (tests/test_pallas_conv.py); on TPU first use runs
@@ -41,6 +62,7 @@ lowering fails.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import math
 from typing import Optional, Tuple
@@ -53,13 +75,14 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # importable on CPU builds
 
+from gansformer_tpu.obs import registry as _obs_registry
 from gansformer_tpu.ops.upfirdn2d import (_pad4 as _xla_pad4,
                                           upfirdn2d as _xla_upfirdn2d)
 
-# Conservative per-invocation VMEM working-set budget (bytes).  The
+# Conservative per-invocation VMEM working-set budget (bytes).  Read at
+# call time (tests shrink it to force row-blocking on small grids); the
 # wrapper shrinks the channel block until the fp32 compute footprint of
-# one grid step fits; if even one channel cannot fit (huge grids) the
-# CALLER is expected to fall back to the XLA op.
+# one grid step fits, and the planner shrinks the row block before that.
 _VMEM_BUDGET = 9 * 2**20
 
 _SQRT2 = math.sqrt(2.0)
@@ -73,6 +96,52 @@ _EPILOGUES = {
     "lrelu": (lambda u, a: jnp.where(u >= 0, u, u * a), _SQRT2,
               lambda y, a, g: jnp.where(y >= 0, 1.0, a).astype(y.dtype)),
 }
+
+
+# --------------------------------------------------------------------------
+# Planning vocabulary shared by the conv kernel family
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvPlan:
+    """Static launch plan for one conv-family kernel call.
+
+    ``mode`` is ``'whole'`` (the full image double-buffers in VMEM),
+    ``'rows'`` (stream ``rows``-row output strips with a halo window),
+    or ``'fallback'`` (typed refusal: ``cause='vmem'`` when even a
+    single row strip overflows the budget, ``cause='shape'`` when the
+    kernel family does not implement the shape at all).
+    """
+
+    mode: str
+    rows: Optional[int] = None
+    cause: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.mode != "fallback"
+
+
+_FALLBACK_CAUSES = ("shape", "vmem")
+
+
+def note_conv_fallback(cause: str) -> None:
+    """Count one conv-family XLA fallback at the dispatch seam.
+
+    Emits ``ops/modconv_fallback_total`` plus a per-cause counter
+    (``.._shape_total`` / ``.._vmem_total``) — the registry is
+    name-keyed, so the label rides the name.  Incremented at trace
+    time; a coverage regression therefore shows up in every prom
+    scrape of a run that compiled a fallback, not only in a TPU A/B.
+    """
+    assert cause in _FALLBACK_CAUSES, cause
+    _obs_registry.counter("ops/modconv_fallback_total").inc()
+    _obs_registry.counter(f"ops/modconv_fallback_{cause}_total").inc()
+
+
+def _divisors_desc(n: int):
+    return sorted((d for d in range(1, n + 1) if n % d == 0), reverse=True)
 
 
 def _out_hw(h: int, w: int, fh: int, fw: int, up: int, down: int,
@@ -95,15 +164,61 @@ def grad_pad4(in_h: int, in_w: int, fh: int, fw: int, up: int, down: int,
             fw - px0 - 1, in_w * up - ow * down + px0 - up + 1)
 
 
-def _pick_block_c(h: int, w: int, c: int, fh: int, fw: int, up: int,
-                  down: int, pad4: Tuple[int, int, int, int]) -> Optional[int]:
-    """Largest divisor of ``c`` whose one-step fp32 footprint (padded
-    input + output + one tap slice) fits the budget; None = does not fit
-    even at one channel (caller falls back to XLA)."""
+def _row_geometry(bh: int, fh: int, up: int, down: int, py0: int):
+    """Static per-strip row algebra (derivation in docs/pallas.md).
+
+    Returns ``(q, we, pa0, c0, rows_in)``: input rows advanced per
+    strip, padded-grid rows one strip reads, the top pre-pad (negative
+    = crop), the phase residual consumed as the in-kernel tap offset,
+    and the input-window row count.
+    """
+    assert (bh * down) % up == 0, (bh, up, down)
+    q = bh * down // up
+    we = (bh - 1) * down + fh
+    pa0 = -((-py0) // up)
+    c0 = pa0 * up - py0
+    rows_in = -(-(we + c0) // up)
+    return q, we, pa0, c0, rows_in
+
+
+def _per_c_bytes(h: int, w: int, fh: int, fw: int, up: int, down: int,
+                 pad4: Tuple[int, int, int, int],
+                 bh: Optional[int] = None) -> int:
+    """fp32 one-step VMEM footprint per channel: input window + padded
+    zero-inserted intermediate + output strip (double-counted for the
+    tap accumulator).  ``bh=None`` = whole image."""
     oh, ow = _out_hw(h, w, fh, fw, up, down, pad4)
-    ph = h * up + max(pad4[0], 0) + max(pad4[1], 0)
     pw = w * up + max(pad4[2], 0) + max(pad4[3], 0)
-    per_c = 4 * (h * w + ph * pw + 2 * oh * ow)
+    if bh is None:
+        ph = h * up + max(pad4[0], 0) + max(pad4[1], 0)
+        return 4 * (h * w + ph * pw + 2 * oh * ow)
+    _, _, _, _, rows_in = _row_geometry(bh, fh, up, down, pad4[0])
+    return 4 * (rows_in * w + rows_in * up * pw + 2 * bh * ow)
+
+
+def upfirdn_plan(x_shape: Tuple[int, ...], f_shape: Tuple[int, int],
+                 up: int, down: int,
+                 pad4: Tuple[int, int, int, int]) -> ConvPlan:
+    """Row-block planner for one upfirdn launch: whole image when it
+    double-buffers within the budget, else the LARGEST output-row strip
+    ``bh | oh`` with ``up | bh*down`` whose window fits; typed vmem
+    fallback only when a single-row strip still overflows."""
+    _, h, w, c = x_shape
+    fh, fw = f_shape
+    if _per_c_bytes(h, w, fh, fw, up, down, pad4) <= _VMEM_BUDGET:
+        return ConvPlan("whole")
+    oh, _ = _out_hw(h, w, fh, fw, up, down, pad4)
+    for bh in _divisors_desc(oh):
+        if bh == oh or (bh * down) % up:
+            continue
+        if _per_c_bytes(h, w, fh, fw, up, down, pad4, bh) <= _VMEM_BUDGET:
+            return ConvPlan("rows", rows=bh)
+    return ConvPlan("fallback", cause="vmem")
+
+
+def _pick_block_c(per_c: int, c: int) -> Optional[int]:
+    """Largest divisor of ``c`` whose one-step fp32 footprint fits the
+    budget; None = does not fit even at one channel."""
     if per_c > _VMEM_BUDGET:
         return None
     bc = c
@@ -114,32 +229,57 @@ def _pick_block_c(h: int, w: int, c: int, fh: int, fw: int, up: int,
     return bc
 
 
-def _upfirdn_body(x_ref, b_ref, o_ref, *, f, up, down, pad4, act, alpha,
-                  gain):
-    py0, py1, px0, px1 = pad4
-    x = x_ref[0].astype(jnp.float32)                    # [H, W, bc]
+def upfirdn_fits(x_shape: Tuple[int, ...], f_shape: Tuple[int, int],
+                 up: int, down: int,
+                 pad4: Tuple[int, int, int, int]) -> bool:
+    """Static verdict for this call — True iff BOTH the forward launch
+    and its adjoint (the backward kernel reuses the forward with
+    up↔down swapped) have an ok plan.  The dispatch gate callers use
+    before choosing the pallas path (False → XLA composite)."""
+    _, h, w, c = x_shape
+    fh, fw = f_shape
+    if not upfirdn_plan(x_shape, f_shape, up, down, pad4).ok:
+        return False
+    oh, ow = _out_hw(h, w, fh, fw, up, down, pad4)
+    gpad4 = grad_pad4(h, w, fh, fw, up, down, pad4)
+    return upfirdn_plan((x_shape[0], oh, ow, c), f_shape, down, up,
+                        gpad4).ok
+
+
+# --------------------------------------------------------------------------
+# Kernel body + launch
+# --------------------------------------------------------------------------
+
+
+def _upfirdn_body(x_ref, b_ref, o_ref, *, f, up, down, rpad, cpad, r0, obh,
+                  act, alpha, gain):
+    x = x_ref[0].astype(jnp.float32)                    # [rows_in, W, bc]
     # ONE lax.pad: interior dilation = zero-insertion upsample, negative
     # edge padding = crop.  upfirdn places up-1 zeros AFTER every sample
     # (including the last) — interior dilation stops at the last sample,
     # so the missing trailing zeros fold into the high edge pad, exactly
-    # like the XLA wrapper's lhs_dilation bookkeeping.
+    # like the XLA wrapper's lhs_dilation bookkeeping.  Row-blocked
+    # strips arrive pre-padded/cropped (the wrapper's pa0/pa1), so their
+    # row edge pads are just the trailing zero-insertion.
     xp = lax.pad(x, jnp.float32(0),
-                 ((py0, py1 + up - 1, up - 1),
-                  (px0, px1 + up - 1, up - 1),
+                 ((rpad[0], rpad[1], up - 1),
+                  (cpad[0], cpad[1], up - 1),
                   (0, 0, 0)))
     fh, fw = f.shape
-    oh = (xp.shape[0] - fh) // down + 1
     ow = (xp.shape[1] - fw) // down + 1
     bc = x.shape[-1]
     ff = f[::-1, ::-1]                                  # true convolution
-    acc = jnp.zeros((oh, ow, bc), jnp.float32)
+    acc = jnp.zeros((obh, ow, bc), jnp.float32)
     for a in range(fh):                                 # static unroll
         for b in range(fw):
             tap = float(ff[a, b])
             if tap == 0.0:
                 continue
-            sl = lax.slice(xp, (a, b, 0),
-                           (a + (oh - 1) * down + 1,
+            # r0 = phase residual c0 in blocked mode (0 whole-image):
+            # local padded row r0 + t*down + a is global padded row
+            # r*bh*down + t*down + a for output strip row t.
+            sl = lax.slice(xp, (r0 + a, b, 0),
+                           (r0 + a + (obh - 1) * down + 1,
                             b + (ow - 1) * down + 1, bc),
                            (down, down, 1))
             acc = acc + tap * sl
@@ -160,21 +300,71 @@ def _upfirdn_kernel_nobias(x_ref, o_ref, **kw):
 def _ufd_call(x: jax.Array, f: np.ndarray, up: int, down: int,
               pad4: Tuple[int, int, int, int], bias: Optional[jax.Array],
               act: Optional[str], alpha: float, gain: float,
-              interpret: bool) -> jax.Array:
+              rows: Optional[int], interpret: bool) -> jax.Array:
     n, h, w, c = x.shape
     fh, fw = f.shape
     oh, ow = _out_hw(h, w, fh, fw, up, down, pad4)
-    bc = _pick_block_c(h, w, c, fh, fw, up, down, pad4)
+    py0, py1, px0, px1 = pad4
+    if rows is not None and rows >= oh:
+        rows = None                                     # degenerate: whole
+    kern_fn = _upfirdn_kernel if bias is not None else _upfirdn_kernel_nobias
+    cpad = (px0, px1 + up - 1)
+    if rows is None:
+        per_c = _per_c_bytes(h, w, fh, fw, up, down, pad4)
+        bc = _pick_block_c(per_c, c)
+        assert bc is not None, "caller must gate on upfirdn_fits()"
+        kern = functools.partial(
+            kern_fn, f=f, up=up, down=down, rpad=(py0, py1 + up - 1),
+            cpad=cpad, r0=0, obh=oh, act=act, alpha=alpha, gain=gain)
+        grid = (n, c // bc)
+        in_specs = [pl.BlockSpec((1, h, w, bc), lambda i, j: (i, 0, 0, j),
+                                 memory_space=pltpu.VMEM)]
+        args = [x]
+        if bias is not None:
+            in_specs.append(pl.BlockSpec((1, bc), lambda i, j: (0, j),
+                                         memory_space=pltpu.VMEM))
+            args.append(bias.reshape(1, c))
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((n, oh, ow, c), x.dtype),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, oh, ow, bc),
+                                   lambda i, j: (i, 0, 0, j),
+                                   memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(*args)
+    # Row-blocked launch: output strips of bh rows; input windows of
+    # rows_in rows at element offset r*q through an Unblocked spec, so
+    # consecutive windows overlap by the halo.  Row pads/crops (pa0/pa1)
+    # are applied ONCE in HBM here — inside the custom_jvp primal, so
+    # autodiff never sees them.
+    bh = rows
+    assert oh % bh == 0 and (bh * down) % up == 0, (oh, bh, up, down)
+    q, _, pa0, c0, rows_in = _row_geometry(bh, fh, up, down, py0)
+    nb = oh // bh
+    xr = x
+    if pa0 > 0:
+        xr = jnp.pad(xr, ((0, 0), (pa0, 0), (0, 0), (0, 0)))
+    elif pa0 < 0:
+        xr = xr[:, -pa0:]
+    pa1 = (nb - 1) * q + rows_in - (h + pa0)
+    if pa1 > 0:
+        xr = jnp.pad(xr, ((0, 0), (0, pa1), (0, 0), (0, 0)))
+    per_c = _per_c_bytes(h, w, fh, fw, up, down, pad4, bh)
+    bc = _pick_block_c(per_c, c)
     assert bc is not None, "caller must gate on upfirdn_fits()"
-    grid = (n, c // bc)
     kern = functools.partial(
-        _upfirdn_kernel if bias is not None else _upfirdn_kernel_nobias,
-        f=f, up=up, down=down, pad4=pad4, act=act, alpha=alpha, gain=gain)
-    in_specs = [pl.BlockSpec((1, h, w, bc), lambda i, j: (i, 0, 0, j),
+        kern_fn, f=f, up=up, down=down, rpad=(0, up - 1), cpad=cpad,
+        r0=c0, obh=bh, act=act, alpha=alpha, gain=gain)
+    grid = (n, c // bc, nb)
+    in_specs = [pl.BlockSpec((1, rows_in, w, bc),
+                             lambda i, j, r: (i, r * q, 0, j * bc),
+                             indexing_mode=pl.Unblocked(),
                              memory_space=pltpu.VMEM)]
-    args = [x]
+    args = [xr]
     if bias is not None:
-        in_specs.append(pl.BlockSpec((1, bc), lambda i, j: (0, j),
+        in_specs.append(pl.BlockSpec((1, bc), lambda i, j, r: (0, j),
                                      memory_space=pltpu.VMEM))
         args.append(bias.reshape(1, c))
     return pl.pallas_call(
@@ -182,25 +372,19 @@ def _ufd_call(x: jax.Array, f: np.ndarray, up: int, down: int,
         out_shape=jax.ShapeDtypeStruct((n, oh, ow, c), x.dtype),
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, oh, ow, bc), lambda i, j: (i, 0, 0, j),
+        out_specs=pl.BlockSpec((1, bh, ow, bc),
+                               lambda i, j, r: (i, r, 0, j),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(*args)
 
 
-def upfirdn_fits(x_shape: Tuple[int, ...], f_shape: Tuple[int, int],
-                 up: int, down: int,
-                 pad4: Tuple[int, int, int, int]) -> bool:
-    """Static VMEM-fit verdict for this call — the dispatch gate callers
-    use before choosing the pallas path (False → XLA composite)."""
-    _, h, w, c = x_shape
-    return _pick_block_c(h, w, c, f_shape[0], f_shape[1], up, down,
-                         pad4) is not None
-
-
 # --------------------------------------------------------------------------
 # Derivative rules (PR-9 layering: custom_vjp over kernel-running
-# custom_jvp composites; tangents are jnp/XLA reference glue).
+# custom_jvp composites; tangents are jnp/XLA reference glue).  The row
+# plans (``rows`` for the forward launch, ``grows`` for the adjoint's
+# own launch) ride the nondiff statics so every re-entry — including
+# the R1/PL second-order paths — lands on a planned kernel.
 # --------------------------------------------------------------------------
 
 
@@ -208,16 +392,17 @@ def _f_np(f_tup) -> np.ndarray:
     return np.asarray(f_tup, np.float32)
 
 
-@functools.partial(jax.custom_jvp, nondiff_argnums=(1, 2, 3, 4, 5))
-def _ufd_plain(x, f_tup, up, down, pad4, interpret):
+@functools.partial(jax.custom_jvp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def _ufd_plain(x, f_tup, up, down, pad4, rows, interpret):
     return _ufd_call(x, _f_np(f_tup), up, down, pad4, None, None, 0.0,
-                     1.0, interpret)
+                     1.0, rows, interpret)
 
 
 @_ufd_plain.defjvp
-def _ufd_plain_jvp(f_tup, up, down, pad4, interpret, primals, tangents):
+def _ufd_plain_jvp(f_tup, up, down, pad4, rows, interpret, primals,
+                   tangents):
     (x,), (tx,) = primals, tangents
-    out = _ufd_plain(x, f_tup, up, down, pad4, interpret)
+    out = _ufd_plain(x, f_tup, up, down, pad4, rows, interpret)
     # upfirdn is linear: the tangent is the op applied to the tangent —
     # via the XLA reference so further transforms (the reg programs'
     # transposes) stay closed.
@@ -225,19 +410,21 @@ def _ufd_plain_jvp(f_tup, up, down, pad4, interpret, primals, tangents):
     return out, tan
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
-def _ufd(x, f_tup, up, down, pad4, gpad4, interpret):
-    return _ufd_plain(x, f_tup, up, down, pad4, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def _ufd(x, f_tup, up, down, pad4, gpad4, rows, grows, interpret):
+    return _ufd_plain(x, f_tup, up, down, pad4, rows, interpret)
 
 
-def _ufd_fwd_rule(x, f_tup, up, down, pad4, gpad4, interpret):
-    return _ufd(x, f_tup, up, down, pad4, gpad4, interpret), None
+def _ufd_fwd_rule(x, f_tup, up, down, pad4, gpad4, rows, grows, interpret):
+    return _ufd(x, f_tup, up, down, pad4, gpad4, rows, grows,
+                interpret), None
 
 
-def _ufd_bwd_rule(f_tup, up, down, pad4, gpad4, interpret, res, ct):
+def _ufd_bwd_rule(f_tup, up, down, pad4, gpad4, rows, grows, interpret,
+                  res, ct):
     del res
     f_flip = tuple(tuple(row) for row in _f_np(f_tup)[::-1, ::-1])
-    return (_ufd_plain(ct, f_flip, down, up, gpad4, interpret),)
+    return (_ufd_plain(ct, f_flip, down, up, gpad4, grows, interpret),)
 
 
 _ufd.defvjp(_ufd_fwd_rule, _ufd_bwd_rule)
@@ -250,17 +437,19 @@ def _ref_with_epilogue(x, b, f_np, up, down, pad4, act, alpha, gain):
     return fused_bias_act(y, b, act=act, alpha=alpha, gain=gain)
 
 
-@functools.partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
-def _ufd_ba_plain(x, b, f_tup, up, down, pad4, act, alpha, gain, interpret):
+@functools.partial(jax.custom_jvp,
+                   nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+def _ufd_ba_plain(x, b, f_tup, up, down, pad4, rows, act, alpha, gain,
+                  interpret):
     return _ufd_call(x, _f_np(f_tup), up, down, pad4, b, act, alpha, gain,
-                     interpret)
+                     rows, interpret)
 
 
 @_ufd_ba_plain.defjvp
-def _ufd_ba_plain_jvp(f_tup, up, down, pad4, act, alpha, gain, interpret,
-                      primals, tangents):
-    out = _ufd_ba_plain(*primals, f_tup, up, down, pad4, act, alpha, gain,
-                        interpret)
+def _ufd_ba_plain_jvp(f_tup, up, down, pad4, rows, act, alpha, gain,
+                      interpret, primals, tangents):
+    out = _ufd_ba_plain(*primals, f_tup, up, down, pad4, rows, act, alpha,
+                        gain, interpret)
     _, tan = jax.jvp(
         lambda x, b: _ref_with_epilogue(x, b, _f_np(f_tup), up, down, pad4,
                                         act, alpha, gain),
@@ -268,22 +457,23 @@ def _ufd_ba_plain_jvp(f_tup, up, down, pad4, act, alpha, gain, interpret,
     return out, tan
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9,
-                                                    10))
-def _ufd_ba(x, b, f_tup, up, down, pad4, gpad4, act, alpha, gain, interpret):
-    return _ufd_ba_plain(x, b, f_tup, up, down, pad4, act, alpha, gain,
-                         interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
+def _ufd_ba(x, b, f_tup, up, down, pad4, gpad4, rows, grows, act, alpha,
+            gain, interpret):
+    return _ufd_ba_plain(x, b, f_tup, up, down, pad4, rows, act, alpha,
+                         gain, interpret)
 
 
-def _ufd_ba_fwd_rule(x, b, f_tup, up, down, pad4, gpad4, act, alpha, gain,
-                     interpret):
-    y = _ufd_ba(x, b, f_tup, up, down, pad4, gpad4, act, alpha, gain,
-                interpret)
+def _ufd_ba_fwd_rule(x, b, f_tup, up, down, pad4, gpad4, rows, grows, act,
+                     alpha, gain, interpret):
+    y = _ufd_ba(x, b, f_tup, up, down, pad4, gpad4, rows, grows, act,
+                alpha, gain, interpret)
     return y, (y,)
 
 
-def _ufd_ba_bwd_rule(f_tup, up, down, pad4, gpad4, act, alpha, gain,
-                     interpret, res, ct):
+def _ufd_ba_bwd_rule(f_tup, up, down, pad4, gpad4, rows, grows, act, alpha,
+                     gain, interpret, res, ct):
     # Activation recovery from the SAVED post-act output (lrelu keeps the
     # sign through the positive gain), then the linear adjoint kernel —
     # all glue is plain jnp, so R1/PL transposes close over this rule.
@@ -293,7 +483,8 @@ def _ufd_ba_bwd_rule(f_tup, up, down, pad4, gpad4, act, alpha, gain,
           * gain)
     db = jnp.sum(du, axis=(0, 1, 2)).astype(jnp.float32)
     f_flip = tuple(tuple(row) for row in _f_np(f_tup)[::-1, ::-1])
-    dx = _ufd_plain(du.astype(ct.dtype), f_flip, down, up, gpad4, interpret)
+    dx = _ufd_plain(du.astype(ct.dtype), f_flip, down, up, gpad4, grows,
+                    interpret)
     return dx, db
 
 
@@ -309,6 +500,7 @@ def upfirdn2d_pallas(x: jax.Array, f, up: int = 1, down: int = 1,
                      pad=0, *, bias: Optional[jax.Array] = None,
                      act: Optional[str] = None, alpha: float = 0.2,
                      gain: Optional[float] = None,
+                     block_rows: Optional[int] = None,
                      interpret: Optional[bool] = None) -> jax.Array:
     """Fused pad→FIR→resample kernel; drop-in for ``ops.upfirdn2d`` with
     an optional fused ``act(y + bias) * gain`` epilogue (linear/lrelu).
@@ -316,7 +508,10 @@ def upfirdn2d_pallas(x: jax.Array, f, up: int = 1, down: int = 1,
     ``f`` must be a static (numpy) filter — it always is in this
     codebase.  Differentiable to second order in ``x`` (and ``bias``);
     ``interpret=None`` auto-selects interpret mode off-TPU, mirroring
-    ``models/attention.py``'s backend dispatch.
+    ``models/attention.py``'s backend dispatch.  Row blocking comes
+    from ``upfirdn_plan`` (the adjoint plans its own rows);
+    ``block_rows`` overrides the FORWARD launch's row strip — a test
+    hook for blocked-vs-whole parity, not a tuning surface.
     """
     assert x.ndim == 4, "expected NHWC"
     f_np = np.asarray(f, np.float32)
@@ -325,16 +520,23 @@ def upfirdn2d_pallas(x: jax.Array, f, up: int = 1, down: int = 1,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n, h, w, c = x.shape
+    fh, fw = f_np.shape
     f_tup = tuple(tuple(float(v) for v in row) for row in f_np)
-    gpad4 = grad_pad4(h, w, f_np.shape[0], f_np.shape[1], up, down, pad4)
+    oh, ow = _out_hw(h, w, fh, fw, up, down, pad4)
+    gpad4 = grad_pad4(h, w, fh, fw, up, down, pad4)
+    plan = upfirdn_plan(x.shape, f_np.shape, up, down, pad4)
+    gplan = upfirdn_plan((n, oh, ow, c), f_np.shape, down, up, gpad4)
+    assert plan.ok and gplan.ok, "caller must gate on upfirdn_fits()"
+    rows = plan.rows if block_rows is None else block_rows
+    grows = gplan.rows
     if act is None:
         assert bias is None, "bias without act: pass act='linear'"
-        return _ufd(x, f_tup, up, down, pad4, gpad4, interpret)
+        return _ufd(x, f_tup, up, down, pad4, gpad4, rows, grows, interpret)
     assert act in _EPILOGUES, (
         f"fused epilogue supports {sorted(_EPILOGUES)}, got {act!r} — "
         f"apply other activations via ops.fused_bias_act after the kernel")
     g = _EPILOGUES[act][1] if gain is None else gain
     b = (jnp.zeros((c,), jnp.float32) if bias is None
          else bias.astype(jnp.float32))
-    return _ufd_ba(x, b, f_tup, up, down, pad4, gpad4, act, alpha, float(g),
-                   interpret)
+    return _ufd_ba(x, b, f_tup, up, down, pad4, gpad4, rows, grows, act,
+                   alpha, float(g), interpret)

@@ -74,12 +74,16 @@ def upfirdn2d(x: jax.Array, f, up: int = 1, down: int = 1,
       4. keep every ``down``-th sample.
 
     ``backend='pallas'`` routes through the fused pad→FIR→resample
-    kernel (``ops/pallas_upfirdn.py``, ISSUE 14) when this call's VMEM
-    footprint fits; oversized grids fall back to the XLA lowering below.
+    kernel (``ops/pallas_upfirdn.py``, ISSUE 14): whole-image or
+    row-blocked per ``upfirdn_plan``; a grid where even a single row
+    strip overflows VMEM falls back to the XLA lowering below and
+    counts ``ops/modconv_fallback_total`` (the conv family's fallback
+    counter — the blur legs are part of the family's coverage).
     """
     assert x.ndim == 4, "expected NHWC"
     if backend == "pallas":
-        from gansformer_tpu.ops.pallas_upfirdn import (upfirdn_fits,
+        from gansformer_tpu.ops.pallas_upfirdn import (note_conv_fallback,
+                                                       upfirdn_fits,
                                                        upfirdn2d_pallas)
 
         f_np = np.asarray(f, np.float32)
@@ -87,6 +91,7 @@ def upfirdn2d(x: jax.Array, f, up: int = 1, down: int = 1,
             f_np = np.outer(f_np, f_np)
         if upfirdn_fits(x.shape, f_np.shape, up, down, _pad4(pad)):
             return upfirdn2d_pallas(x, f_np, up=up, down=down, pad=pad)
+        note_conv_fallback("vmem")
     f = jnp.asarray(f, dtype=x.dtype)
     assert f.ndim == 2
     pady0, pady1, padx0, padx1 = _pad4(pad)

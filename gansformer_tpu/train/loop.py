@@ -247,6 +247,13 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     for c in ("data/read_retries_total", "data/corrupt_records_total",
               "data/stalls_total"):
         obs.get_registry().counter(c)
+    # Conv-family fallback counters (ISSUE 17): same discipline — a 0 in
+    # the scrape is a positive "no silent XLA fallback" claim.  The
+    # dispatchers (ops/pallas_modconv.py, ops/upfirdn2d.py) increment
+    # these at trace time via ops.pallas_upfirdn.note_conv_fallback.
+    for c in ("ops/modconv_fallback_total", "ops/modconv_fallback_shape_total",
+              "ops/modconv_fallback_vmem_total"):
+        obs.get_registry().counter(c)
     obs.get_registry().gauge("data/corrupt_frac").set(0.0)
     obs.get_registry().gauge("data/corrupt_budget_frac").set(
         cfg.data.max_corrupt_frac)

@@ -94,7 +94,11 @@ def lever_catalog():
             # up-conv / upfirdn kernel family as a steppable lever — the
             # 'on' variant compiles the REAL g step with
             # conv_backend='pallas' (interpret mode off-TPU: structure
-            # only; a tunnel window prices the native ms delta).
+            # only; a tunnel window prices the native ms delta).  Since
+            # ISSUE 17's halo row blocking the 'on' program carries the
+            # kernels at EVERY grid of the preset (256²/512²/1024²
+            # row-block instead of silently falling back), so the delta
+            # prices the whole family, not just the small grids.
             "name": "conv_fused_mod",
             "phase": "g",
             "flag": "--conv-backend (ModelConfig.conv_backend)",

@@ -198,10 +198,16 @@ def default_stages():
         #    conv/upfirdn kernels (the 33%→51% MFU tier, ROADMAP 1)
         #    priced on the REAL step programs with zero new plumbing.
         #    Gated by the conv-family native smoke check inside the
-        #    script (skip-don't-crash; xla rows still land).
+        #    script (skip-don't-crash; xla rows still land).  The
+        #    preset is pinned: with ISSUE 17's row blocking the ffhq256
+        #    step programs route EVERY conv/FIR grid through the Pallas
+        #    kernels (pre-17 the 128²/256² grids silently fell back to
+        #    XLA, so this A/B priced only the small grids); the smoke
+        #    check now also lowers a row-blocked fwd+bwd natively.
         stage("modconv_train_ab", 1500, "modconv_train_ab_tpu.jsonl",
               [py, "scripts/bench_pallas_attention.py", "--train-ab",
-               "--ab-backend", "conv", "--batch", "8"]),
+               "--ab-backend", "conv", "--preset", "ffhq256-duplex",
+               "--batch", "8"]),
         # 9. Real loop on the chip — now run UNDER the supervisor with
         #    one injected SIGKILL mid-checkpoint (ISSUE 12), so every
         #    tunnel window that trains also PROVES crash→resume recovery

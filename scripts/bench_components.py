@@ -278,7 +278,22 @@ def main(argv=None) -> int:
                                               backend="pallas"),
             skip_down=lambda x, w: conv2d(x, w, down=2, backend="pallas"))
 
-    for res in [r for r in (32, 64, 128, 256) if r <= cfg.resolution]:
+    # 512/1024 joined the sweep with ISSUE 17's row blocking — before
+    # it these grids couldn't exist as pallas twins (the VMEM gate fell
+    # back), so the ffhq1024 attribution table re-ranks under full
+    # coverage.  Each conv component carries its launch-plan fields
+    # (plan_mode/plan_rows from the SAME planner the dispatcher uses),
+    # making a kernel win attributable to whole-image vs row-blocked
+    # streaming rather than just "pallas".
+    from gansformer_tpu.ops.pallas_modconv import modconv_plan
+    from gansformer_tpu.ops.pallas_upfirdn import upfirdn_plan
+
+    def plan_fields(plan):
+        return {"plan_mode": plan.mode, "plan_rows": plan.rows}
+
+    itemsize = jnp.dtype(dtype).itemsize
+    for res in [r for r in (32, 64, 128, 256, 512, 1024)
+                if r <= cfg.resolution]:
         c = cfg.nf(res)
         c_out = cfg.nf(res // 2)
         x = jnp.asarray(rs.randn(b, res, res, c), dtype)
@@ -289,17 +304,21 @@ def main(argv=None) -> int:
         w1 = jnp.asarray(rs.randn(1, 1, c, c_out) * 0.1, dtype)
         styles = jnp.asarray(rs.randn(b, c), jnp.float32)
         want_vjp = res * 2 in (cfg.resolution, cfg.resolution // 2)
+        plan3 = modconv_plan(x.shape, w3.shape, up=1, itemsize=itemsize)
+        plan_up = modconv_plan(x.shape, w3.shape, up=2, itemsize=itemsize)
+        plan_bu = upfirdn_plan(x.shape, (4, 4), 2, 1, (2, 1, 2, 1))
+        plan_bd = upfirdn_plan(x.shape, (4, 4), 1, 2, (1, 1, 1, 1))
         for backend in conv_backends:
             fns = conv_fns(backend)
             tag = "" if backend == "xla" else "pallas_"
             timed(f"modconv3x3_{tag}{res}",
                   lambda x, w, s: fns.modconv(x, w, s),
                   x, w3, styles, res=res, cin=c, cout=c,
-                  conv_backend=backend)
+                  conv_backend=backend, **plan_fields(plan3))
             timed(f"modconv3x3_up2_{tag}{res}",
                   lambda x, w, s: fns.modconv(x, w, s, up=2),
                   x, w3, styles, res=res, cin=c, cout=c,
-                  conv_backend=backend)
+                  conv_backend=backend, **plan_fields(plan_up))
             if want_vjp:
                 # First-order backward of the up-conv feeding the
                 # 128²/256² grids — the grad-path share of the G time
@@ -313,11 +332,13 @@ def main(argv=None) -> int:
                       lambda x, w, s: jax.grad(
                           upconv_loss, argnums=(0, 1, 2))(x, w, s),
                       x, w3, styles, res=res, cin=c, cout=c,
-                      conv_backend=backend)
+                      conv_backend=backend, **plan_fields(plan_up))
             timed(f"blur_up2_{tag}{res}", fns.blur_up,
-                  x, res=res, chans=c, conv_backend=backend)
+                  x, res=res, chans=c, conv_backend=backend,
+                  **plan_fields(plan_bu))
             timed(f"blur_down2_{tag}{res}", fns.blur_down,
-                  x, res=res, chans=c, conv_backend=backend)
+                  x, res=res, chans=c, conv_backend=backend,
+                  **plan_fields(plan_bd))
             if want_vjp:
                 def blur_loss(x):
                     y = fns.blur_up(x)
@@ -325,10 +346,12 @@ def main(argv=None) -> int:
 
                 timed(f"blur_up2_vjp_{tag}{res}",
                       lambda x: jax.grad(blur_loss)(x),
-                      x, res=res, chans=c, conv_backend=backend)
+                      x, res=res, chans=c, conv_backend=backend,
+                      **plan_fields(plan_bu))
             # D-skip 1x1 down-conv: decimated blur (PERF.md §1b'''').
             timed(f"skip_down_decimated_{tag}{res}", fns.skip_down,
-                  x, w1, res=res, cin=c, cout=c_out, conv_backend=backend)
+                  x, w1, res=res, cin=c, cout=c_out, conv_backend=backend,
+                  **plan_fields(plan_bd))
         # The pre-polyphase dense-at-2H formulation, timed for the on-chip
         # before/after comparison (PERF.md §1b''') — xla-only study.
         timed(f"upconv_dense_{res}",

@@ -251,7 +251,13 @@ def test_lint_run_dir_findings_and_cli(tmp_path, capsys):
         "# TYPE data_corrupt_records_total counter\n"
         "data_corrupt_records_total 0.0\n"
         "# TYPE data_stalls_total counter\n"
-        "data_stalls_total 0.0\n")
+        "data_stalls_total 0.0\n"
+        "# TYPE ops_modconv_fallback_total counter\n"
+        "ops_modconv_fallback_total 0.0\n"
+        "# TYPE ops_modconv_fallback_shape_total counter\n"
+        "ops_modconv_fallback_shape_total 0.0\n"
+        "# TYPE ops_modconv_fallback_vmem_total counter\n"
+        "ops_modconv_fallback_vmem_total 0.0\n")
     assert lint_run_dir(str(tmp_path)) == []
 
     rc = cli_main(["--run-dir", str(tmp_path)])
@@ -274,7 +280,10 @@ def test_check_metric_families_value_aware(tmp_path):
 
     p = tmp_path / "telemetry.prom"
     data = ("data_read_retries_total 0.0\n"
-            "data_corrupt_records_total 0.0\ndata_stalls_total 0.0\n")
+            "data_corrupt_records_total 0.0\ndata_stalls_total 0.0\n"
+            "ops_modconv_fallback_total 0.0\n"
+            "ops_modconv_fallback_shape_total 0.0\n"
+            "ops_modconv_fallback_vmem_total 0.0\n")
     base = ("hbm_unavailable 0.0\nhbm_bytes_in_use 1.0\n"
             "hbm_peak_bytes 2.0\ncompile_compiles_total 1.0\n"
             "compile_retraces_total 0.0\n" + data)
@@ -302,15 +311,21 @@ def test_check_metric_families_data_robustness(tmp_path):
 
     head = ("device_sampler_off 1.0\nhbm_unavailable 1.0\n"
             "compile_compiles_total 1.0\ncompile_retraces_total 0.0\n")
+    ops = ("ops_modconv_fallback_total 0.0\n"
+           "ops_modconv_fallback_shape_total 0.0\n"
+           "ops_modconv_fallback_vmem_total 0.0\n")
     p = tmp_path / "telemetry.prom"
-    # missing family members
+    # missing family members (the ISSUE-17 conv fallback counters are
+    # held to the same explicit-marker discipline)
     p.write_text(head)
     errs = check_metric_families(str(p))
     for name in ("data_read_retries_total", "data_corrupt_records_total",
-                 "data_stalls_total"):
+                 "data_stalls_total", "ops_modconv_fallback_total",
+                 "ops_modconv_fallback_shape_total",
+                 "ops_modconv_fallback_vmem_total"):
         assert any(name in e for e in errs), (name, errs)
     # quarantines moved without the jsonl ledger beside the prom
-    p.write_text(head + "data_read_retries_total 0.0\n"
+    p.write_text(head + ops + "data_read_retries_total 0.0\n"
                  "data_corrupt_records_total 2.0\ndata_stalls_total 0.0\n")
     assert any("data_quarantine.jsonl" in e
                for e in check_metric_families(str(p)))

@@ -205,28 +205,34 @@ def test_overlap_collapses_h2d_span(micro_run_dir, sync_run_dir):
 def test_overlap_checkpoint_span_is_dispatch_only(
         micro_run_dir, sync_run_dir):
     """Acceptance: the loop-thread checkpoint cost must not include the
-    serialize/fsync work (that rides the writer thread).  The loop-thread
-    cost is the ``checkpoint`` span plus its ``ckpt/save`` child (self
-    times are exclusive); the phase lands on the tick AFTER the boundary
-    that saved."""
-    def write_ms(run_dir):
-        # ckpt/write_ms is what the LOOP THREAD paid for its last save
-        # (full serialize+fsync in sync mode, staging dispatch in async
-        # mode); the final telemetry.prom carries it for any tick count.
-        for line in open(os.path.join(run_dir, "telemetry.prom")):
-            if line.startswith("ckpt_write_ms "):
-                return float(line.split()[1])
-        raise AssertionError(f"{run_dir}: no ckpt_write_ms in prom")
+    serialize/fsync work (that rides the writer thread).  Asserted on
+    span COMPOSITION, not a wall-clock race: at the ~1 MB micro scale a
+    sync fsync is occasionally as fast as async staging (the seed's
+    known flake), so instead of comparing durations we assert the async
+    run actually routed its in-loop saves through the writer thread and
+    the sync run never did.  The wall-clock size-independence property —
+    the actual O(dispatch) claim — is pinned with a 64 MB state in
+    tests/test_checkpoint_async.py::
+    test_async_save_loop_cost_is_dispatch_bound."""
+    from gansformer_tpu.obs.registry import parse_prom_values
 
-    s, o = write_ms(sync_run_dir), write_ms(micro_run_dir)
-    assert s > 0
-    # At micro scale the margin is modest (the state is ~1 MB, so the
-    # sync write is only tens-to-hundreds of ms, and on a loaded host the
-    # async dispatch has been observed within a few ms of half the sync
-    # cost); the size-independence property — the actual O(dispatch)
-    # claim — is pinned with a 64 MB state in tests/test_checkpoint_
-    # async.py::test_async_save_loop_cost_is_dispatch_bound.
-    assert o < 0.75 * s, (o, s)
+    o = parse_prom_values(os.path.join(micro_run_dir, "telemetry.prom"))
+    s = parse_prom_values(os.path.join(sync_run_dir, "telemetry.prom"))
+    # Async run: the in-loop saves were SUBMITTED to the writer thread
+    # (ckpt_async_total), completed off-loop (the write_ms histogram
+    # landed observations; ≤ submissions because the prom snapshot may
+    # precede the last drain), and none errored.  The loop thread still
+    # records its own (dispatch-only) ckpt_write_ms.
+    assert o.get("ckpt_async_total", 0.0) >= 1.0, o
+    assert o.get("ckpt_async_write_ms_count", 0.0) >= 1.0, o
+    assert o["ckpt_async_total"] >= o["ckpt_async_write_ms_count"], o
+    assert o.get("ckpt_async_errors_total", 0.0) == 0.0, o
+    assert o.get("ckpt_write_ms", 0.0) > 0.0, o
+    # Sync run: no ckpt_async_* family at all — every save (serialize +
+    # fsync) executed on the loop thread.
+    assert not any(k.startswith("ckpt_async_") for k in s), sorted(
+        k for k in s if k.startswith("ckpt_async_"))
+    assert s.get("ckpt_write_ms", 0.0) > 0.0, s
 
 
 def test_overlap_device_queue_telemetry(micro_run_dir, sync_run_dir):

@@ -98,6 +98,43 @@ def test_console_script_registered():
     assert 'gansformer-lint = "gansformer_tpu.analysis.cli:main"' in content
 
 
+def test_row_blocked_kernel_modules_lint_clean_without_baseline():
+    """The halo row-blocked kernel family (ISSUE 17) must stay clean
+    the strong way: zero raw findings over the two kernel modules —
+    nothing baselined, nothing suppressed — and the shared baseline
+    must carry no entries under them, so a future edit can't quietly
+    grandfather a finding into the hottest code in the repo."""
+    from gansformer_tpu.analysis import lint_paths
+
+    kernel_paths = [
+        os.path.join(ROOT, "gansformer_tpu", "ops", "pallas_modconv.py"),
+        os.path.join(ROOT, "gansformer_tpu", "ops", "pallas_upfirdn.py"),
+    ]
+    findings = lint_paths(kernel_paths)
+    # No baseline applied on purpose: every finding counts as new here.
+    assert findings == [], "row-blocked kernel modules must lint clean " \
+        "with NO baseline entries and NO suppressions:\n" + "\n".join(
+            f"{f.location}: {f.rule}: {f.message}" for f in findings)
+
+    with open(BASELINE) as f:
+        entries = json.load(f)["entries"]
+    kernel_rel = {os.path.relpath(p, ROOT) for p in kernel_paths}
+    leaked = [e for e in entries
+              if e["path"].replace("\\", "/") in kernel_rel]
+    assert leaked == [], f"baseline entries leaked under the kernel " \
+        f"modules: {leaked}"
+
+    # And zero inline suppressions at all — the kernels carry none
+    # today, and the justification escape hatch (the audit below) is
+    # deliberately not available to this pair.
+    for path in kernel_paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        assert "graftlint: disable" not in src, (
+            f"{path}: inline suppression in a row-blocked kernel "
+            f"module — fix the finding instead")
+
+
 def test_suppressions_carry_justifications():
     """Every inline suppression in the production tree must carry a
     justification: prose after the rule id, or a comment on the line
