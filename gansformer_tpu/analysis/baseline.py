@@ -60,14 +60,23 @@ class Baseline:
         """Mark matching findings ``baselined`` (consuming entries, so N
         baseline entries absolve at most N identical findings).
         ``line_text_of(finding)`` returns the flagged line's text."""
+        from gansformer_tpu.analysis.engine import legacy_ids
+
         budget = collections.Counter(self._keys)
         for f in findings:
             if f.suppressed:
                 continue
             key = self._key(f, line_text_of(f))
-            if budget[key] > 0:
-                budget[key] -= 1
-                f.baselined = True
+            # retired-alias compatibility: an entry keyed by a retired
+            # rule id (thread-shared-state::…) still absolves the
+            # successor rule's finding on the same line
+            candidates = [key] + [old + key[len(f.rule):]
+                                  for old in legacy_ids(f.rule)]
+            for k in candidates:
+                if budget[k] > 0:
+                    budget[k] -= 1
+                    f.baselined = True
+                    break
 
     @staticmethod
     def write(path: str, findings: List[Finding], line_text_of) -> None:

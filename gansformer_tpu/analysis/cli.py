@@ -59,11 +59,13 @@ def _select_rules(select: Optional[str], ignore: Optional[str],
     from gansformer_tpu.analysis.trace.base import all_trace_rules
 
     trace_rules = all_trace_rules() if trace else []
+    aliases = engine.rule_aliases()     # retired id -> current id
     ast_ids = {r.id for r in rules}
     trace_ids = {r.id for r in all_trace_rules()}
-    known = ast_ids | trace_ids
+    known = ast_ids | trace_ids | set(aliases)
     if select:
-        wanted = {r.strip() for r in select.split(",") if r.strip()}
+        wanted = {aliases.get(r.strip(), r.strip())
+                  for r in select.split(",") if r.strip()}
         unknown = wanted - known
         if unknown:
             raise SystemExit(
@@ -79,7 +81,8 @@ def _select_rules(select: Optional[str], ignore: Optional[str],
         rules = [r for r in rules if r.id in wanted]
         trace_rules = [r for r in trace_rules if r.id in wanted]
     if ignore:
-        dropped = {r.strip() for r in ignore.split(",") if r.strip()}
+        dropped = {aliases.get(r.strip(), r.strip())
+                   for r in ignore.split(",") if r.strip()}
         unknown = dropped - known
         if unknown:
             raise SystemExit(
@@ -227,9 +230,14 @@ def run_selfcheck(run_dir: str, trace_profile: str = "contracts") -> int:
     if os.path.exists(DEFAULT_BASELINE):
         Baseline.load(DEFAULT_BASELINE).apply(findings, line_text_lookup())
 
+    from gansformer_tpu.analysis.concurrency.thread_model import (
+        summarize_paths)
+
+    extra = dict(comms)
+    extra["thread_model"] = summarize_paths(files, root=pkg_root)
     artifact = os.path.join(run_dir, "graftlint.json")
     with open(artifact, "w", encoding="utf-8") as f:
-        f.write(reporters.render_json(findings, len(files), extra=comms))
+        f.write(reporters.render_json(findings, len(files), extra=extra))
         f.write("\n")
     return sum(1 for f in findings if f.new)
 
@@ -242,6 +250,10 @@ def main(argv=None) -> int:
 
         for cls in engine.all_rules():
             print(f"{cls.id:<26s} {cls.description}")
+        for old, cur in sorted(engine.rule_aliases().items()):
+            print(f"{old:<26s} DEPRECATED alias of {cur} (kept so "
+                  f"existing disable= comments and baseline keys "
+                  f"keep working)")
         for cls in all_trace_rules():
             print(f"{cls.id:<26s} [trace] {cls.description}")
         print(f"{'telemetry-schema':<26s} run-dir artifact schema "
@@ -343,8 +355,15 @@ def main(argv=None) -> int:
             findings.extend(lint_learning_trend(args.run_dir))
 
     if args.format == "json":
-        print(reporters.render_json(findings, len(files),
-                                    extra=comms_payload))
+        from gansformer_tpu.analysis.concurrency.thread_model import (
+            summarize_paths)
+
+        # the thread-model summary rides every JSON report (threads
+        # discovered, locks, entry-point mapping, signal handlers) —
+        # the doctor / future elasticity work consume it
+        extra = dict(comms_payload or {})
+        extra["thread_model"] = summarize_paths(files, root=os.getcwd())
+        print(reporters.render_json(findings, len(files), extra=extra))
     else:
         print(reporters.render_text(findings, len(files),
                                     verbose=args.verbose))

@@ -1,0 +1,38 @@
+"""Concurrency static analysis over the threaded runtime (ISSUE 18).
+
+The repo runs a real threaded runtime beside the jitted hot path —
+``DevicePrefetcher``/``PrefetchIterator`` producer threads,
+``SingleSlotWriter``/``LoopWorker`` background writers, the serving
+dispatcher + supervisor pair, and SIGTERM/drain handlers.  This package
+is the concurrency twin of ``jit_regions.py``: a shared **thread-model
+resolver** (``thread_model.ThreadModel``, reachable per file as
+``ctx.threads``) maps every ``threading.Thread`` / ``LoopWorker``
+construction and ``.submit()`` dispatch to its target function (bare
+name, ``self.method``, lambda, ``functools.partial``), computes the set
+of functions reachable from thread entry points, and records every
+``Lock``/``RLock``/``Condition`` with its acquisition sites.
+
+Five rules ride on top (one module per rule, catalog in
+docs/static-analysis.md):
+
+* ``lock_order``         — lock-order-inversion
+* ``shared_state``       — unguarded-shared-attribute (retires the old
+                           module-literal-only ``thread-shared-state``
+                           rule; the legacy id is kept as an alias)
+* ``lifecycle``          — thread-lifecycle
+* ``signal_safety``      — signal-handler-safety
+* ``condition_protocol`` — condition-protocol
+
+Importing this package registers all five into the engine registry, so
+they run under ``gansformer-lint``, pre-commit, and ``--selfcheck``
+exactly like the AST rules in ``analysis/rules/``.  Everything here is
+pure-AST: no jax import, safe for the fast pre-commit hook.
+"""
+
+from gansformer_tpu.analysis.concurrency import (  # noqa: F401  (registers)
+    condition_protocol,
+    lifecycle,
+    lock_order,
+    shared_state,
+    signal_safety,
+)

@@ -1,0 +1,101 @@
+"""condition-protocol: Condition.wait/notify used off-protocol.
+
+``threading.Condition`` has exactly one correct shape::
+
+    with cv:                      # notify side
+        state_change()
+        cv.notify_all()
+
+    with cv:                      # wait side
+        while not predicate():    # re-check: spurious + missed wakeups
+            cv.wait(timeout)
+
+Flagged, for objects the resolver saw constructed as
+``threading.Condition()`` (an ``Event.wait`` or ``Thread.join`` never
+matches):
+
+* ``cv.wait(…)`` not lexically inside ``with cv:`` — waiting without
+  the lock raises at runtime only on the unlucky interleaving;
+* ``cv.wait(…)`` with no enclosing ``while`` between it and the
+  ``with`` — an ``if``-guarded (or unguarded) wait misses wakeups that
+  land before the wait and trusts every spurious wakeup
+  (``wait_for`` is exempt: the predicate loop is built in);
+* ``cv.notify()`` / ``notify_all()`` outside ``with cv:`` — legal-ish
+  in CPython but a lost-wakeup race against the waiter's predicate
+  check.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+
+_WAITS = {"wait", "wait_for"}
+_NOTIFIES = {"notify", "notify_all"}
+
+
+@register
+class ConditionProtocol(Rule):
+    id = "condition-protocol"
+    description = ("Condition.wait outside a while-predicate loop / "
+                   "with-block, or notify outside the owning lock")
+    hint = ("wrap: `with cv:` + `while not predicate(): cv.wait()`; "
+            "notify under the same `with cv:` that changed the "
+            "predicate state")
+    node_types = (ast.Module,)
+
+    def check(self, node: ast.Module, ctx: FileContext) -> None:
+        tm = ctx.threads
+        conditions = {k for k, site in tm.locks.items()
+                      if site.kind == "condition"}
+        if not conditions:
+            return
+        for call in ast.walk(node):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in (_WAITS | _NOTIFIES)):
+                continue
+            key = tm.lock_key(call.func.value, call)
+            if key is None or key not in conditions:
+                continue
+            in_with, in_while = self._context(call, key, tm)
+            name = key[1]
+            if call.func.attr in _NOTIFIES:
+                if not in_with:
+                    ctx.report(
+                        self, call,
+                        f"{name}.{call.func.attr}() outside `with "
+                        f"{name}:` — racing the waiter's predicate "
+                        f"check loses wakeups")
+            else:
+                if not in_with:
+                    ctx.report(
+                        self, call,
+                        f"{name}.wait() outside `with {name}:` — "
+                        f"Condition.wait requires the lock held")
+                elif call.func.attr == "wait" and not in_while:
+                    ctx.report(
+                        self, call,
+                        f"{name}.wait() not inside a while-predicate "
+                        f"loop — spurious and early wakeups break an "
+                        f"if-guarded wait; loop on the predicate (or "
+                        f"use wait_for)")
+
+    @staticmethod
+    def _context(call: ast.Call, key, tm):
+        """(inside `with key:`, a While sits between wait and the with)."""
+        in_while = False
+        n = tm.parent(call)
+        while n is not None:
+            if isinstance(n, ast.While):
+                in_while = True
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if tm.lock_key(item.context_expr, n) == key:
+                        return True, in_while
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                break   # the lock cannot be lexically held across defs
+            n = tm.parent(n)
+        return False, in_while

@@ -1,0 +1,168 @@
+"""thread-lifecycle: every started thread must be joinable on teardown.
+
+The ``finally``-drain discipline PRs 12/13 hand-enforced, checked:
+
+* a thread bound to ``self._x`` must have ``self._x.join(…)`` /
+  ``.close(…)`` / ``.wait(…)`` somewhere in its class (aliases like
+  ``t = self._x; t.join(…)`` resolve) — otherwise shutdown abandons it
+  mid-write;
+* a thread bound to a local name must be joined inside a ``finally``
+  (or used as a context manager) in the same function — a join on the
+  happy path only leaks the thread on every exception exit;
+* a fire-and-forget construction (``Thread(...).start()`` with no
+  binding) must be ``daemon=True`` — a non-daemon orphan blocks
+  interpreter exit forever;
+* a daemon thread whose (resolved) target opens external resources —
+  ``open``/``tempfile.*``/``socket.socket`` in its direct body — is
+  flagged: daemons are killed mid-operation at interpreter exit,
+  leaking fds and half-written files.
+
+``.submit()`` dispatches are exempt: the executor object owns the
+thread, and its own ``Thread`` construction is checked where the
+executor class is defined (``SingleSlotWriter`` passes via the
+``t = self._thread; t.join()`` alias path).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+from gansformer_tpu.analysis.jit_regions import dotted_name
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_JOINERS = {"join", "close", "wait"}
+_RESOURCE_CALLS = {"open", "socket.socket", "mkdtemp", "mkstemp",
+                   "NamedTemporaryFile", "TemporaryDirectory"}
+
+
+@register
+class ThreadLifecycle(Rule):
+    id = "thread-lifecycle"
+    description = ("started thread without a join/close on the teardown "
+                   "path, non-daemon fire-and-forget, or a daemon "
+                   "owning fds/tempdirs")
+    hint = ("bind the thread and join it in close()/a finally block; "
+            "fire-and-forget threads must be daemon=True and must not "
+            "own external resources")
+    node_types = (ast.Module,)
+
+    def check(self, node: ast.Module, ctx: FileContext) -> None:
+        tm = ctx.threads
+        for site in tm.thread_sites:
+            if site.kind == "submit":
+                continue
+            if site.binding is None:
+                if site.daemon is not True:
+                    ctx.report(
+                        self, site.node,
+                        "fire-and-forget thread without daemon=True — "
+                        "an orphaned non-daemon thread blocks "
+                        "interpreter exit forever")
+            elif site.binding[0] == "attr":
+                cls = tm.enclosing_class(site.node)
+                if cls is not None and not self._attr_joined(
+                        cls, site.binding[2]):
+                    ctx.report(
+                        self, site.node,
+                        f"thread bound to self.{site.binding[2]} is "
+                        f"never joined/closed in {cls.name} — teardown "
+                        f"abandons it mid-write")
+            else:
+                self._check_local(site, ctx, tm)
+            if site.daemon:
+                self._check_daemon_resources(site, ctx, tm)
+
+    # -- self-attribute bindings ---------------------------------------------
+
+    @staticmethod
+    def _attr_joined(cls: ast.ClassDef, attr: str) -> bool:
+        aliases: Dict[str, str] = {}
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    isinstance(n.value, ast.Attribute) and \
+                    isinstance(n.value.value, ast.Name) and \
+                    n.value.value.id == "self":
+                aliases[n.targets[0].id] = n.value.attr
+        for n in ast.walk(cls):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _JOINERS):
+                continue
+            recv = n.func.value
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self" and recv.attr == attr:
+                return True
+            if isinstance(recv, ast.Name) and \
+                    aliases.get(recv.id) == attr:
+                return True
+        return False
+
+    # -- local-name bindings -------------------------------------------------
+
+    def _check_local(self, site, ctx: FileContext, tm) -> None:
+        name = site.binding[2]
+        fn = tm.enclosing_function(site.node)
+        scope = fn if fn is not None else ctx.tree
+        join_call: Optional[ast.AST] = None
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in _JOINERS and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id == name:
+                join_call = n
+                break
+            if isinstance(n, (ast.With, ast.AsyncWith)) and any(
+                    isinstance(i.context_expr, ast.Name)
+                    and i.context_expr.id == name for i in n.items):
+                return   # context-managed: __exit__ is the join
+        if join_call is None:
+            ctx.report(
+                self, site.node,
+                f"thread bound to {name!r} is never joined in its "
+                f"scope — every exit path leaks the thread")
+        elif not self._in_finally(join_call, tm):
+            ctx.report(
+                self, site.node,
+                f"thread {name!r} is joined only on the happy path — "
+                f"move the join into a finally block so exception "
+                f"exits drain it too")
+
+    @staticmethod
+    def _in_finally(node: ast.AST, tm) -> bool:
+        child, n = node, tm.parent(node)
+        while n is not None:
+            if isinstance(n, ast.Try) and any(
+                    child is s or any(child is d for d in ast.walk(s))
+                    for s in n.finalbody):
+                return True
+            child, n = n, tm.parent(n)
+        return False
+
+    # -- daemon resource ownership -------------------------------------------
+
+    def _check_daemon_resources(self, site, ctx: FileContext, tm) -> None:
+        seen: Set[str] = set()
+        for target in site.targets:
+            for n in tm._own_body(target):
+                if not isinstance(n, ast.Call):
+                    continue
+                name = dotted_name(n.func)
+                last = name.split(".")[-1] if name else ""
+                if name in _RESOURCE_CALLS or last in _RESOURCE_CALLS or \
+                        (name or "").startswith("tempfile."):
+                    what = name or last
+                    if what in seen:
+                        continue
+                    seen.add(what)
+                    ctx.report(
+                        self, n,
+                        f"daemon thread target "
+                        f"{tm.qualname(target)!r} owns an external "
+                        f"resource via {what}() — daemons die "
+                        f"mid-operation at interpreter exit, leaking "
+                        f"fds / half-written files")

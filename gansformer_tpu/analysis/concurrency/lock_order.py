@@ -1,0 +1,121 @@
+"""lock-order-inversion: cyclic lock acquisition orders.
+
+Builds the per-module lock-ordering graph: an edge A→B whenever B is
+acquired while A is held — lexically (``with a: … with b:``) or through
+a call made under A to an in-module function that (transitively)
+acquires B.  A cycle in that graph is the classic ABBA deadlock shape:
+two threads entering from opposite ends block forever, and nothing
+short of production load exercises both interleavings.
+
+A self-edge A→A (re-acquiring a lock already held, via a helper called
+under the lock) is reported too unless the lock is an ``RLock`` —
+``threading.Lock`` and ``Condition`` are non-reentrant, so the "cycle"
+is a single-thread self-deadlock, the service/supervisor-vs-dispatcher
+shape the serving stack dodges by calling ticket callbacks outside
+``_cv``.
+
+Lock identity is the resolver's ``(class, attr)`` key — per-instance
+locks of one class collapse together, which over-approximates exactly
+the way a lock-ORDER discipline should: order must hold per lock
+*role*, not per object.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+from gansformer_tpu.analysis.concurrency.thread_model import (
+    REENTRANT_KINDS, LockKey)
+
+
+def _fmt(key: LockKey) -> str:
+    cls, name = key
+    return f"{cls}.{name}" if cls else name
+
+
+@register
+class LockOrderInversion(Rule):
+    id = "lock-order-inversion"
+    description = ("cyclic lock-acquisition order (ABBA deadlock) or "
+                   "re-acquisition of a non-reentrant lock")
+    hint = ("acquire locks in one global order everywhere, or narrow "
+            "the outer critical section so the call happens after "
+            "release (the serve stack resolves tickets OUTSIDE _cv "
+            "for exactly this reason)")
+    node_types = (ast.Module,)
+
+    def check(self, node: ast.Module, ctx: FileContext) -> None:
+        tm = ctx.threads
+        if not tm.locks and not tm.thread_sites:
+            return
+        edges: Dict[Tuple[LockKey, LockKey], ast.AST] = {}
+
+        def add_edge(a: LockKey, b: LockKey, site: ast.AST) -> None:
+            if a == b and tm.lock_kind(a) in REENTRANT_KINDS:
+                return
+            edges.setdefault((a, b), site)
+
+        for n in ast.walk(node):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                held = tm.held_locks(n)
+                for item in n.items:
+                    key = tm.lock_key(item.context_expr, n)
+                    if key is None:
+                        continue
+                    for a in held:
+                        add_edge(a, key, n)
+            elif isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "acquire":
+                    key = tm.lock_key(n.func.value, n)
+                    if key is not None:
+                        for a in tm.held_locks(n):
+                            add_edge(a, key, n)
+                    continue
+                held = tm.held_locks(n)
+                if not held:
+                    continue
+                callees = tm.resolve_callable(n.func, n)
+                for callee in callees:
+                    for b in tm.acquisitions(callee, transitive=True):
+                        for a in held:
+                            add_edge(a, b, n)
+
+        # self-edges are immediate single-thread deadlocks
+        for (a, b), site in sorted(
+                edges.items(), key=lambda kv: kv[1].lineno):
+            if a == b:
+                ctx.report(
+                    self, site,
+                    f"non-reentrant lock {_fmt(a)!r} re-acquired while "
+                    f"already held (single-thread self-deadlock)")
+
+        adj: Dict[LockKey, Set[LockKey]] = {}
+        for (a, b) in edges:
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+        for (a, b), site in sorted(
+                edges.items(), key=lambda kv: kv[1].lineno):
+            if a != b and self._reaches(adj, b, a):
+                ctx.report(
+                    self, site,
+                    f"lock-order inversion: {_fmt(b)!r} acquired while "
+                    f"holding {_fmt(a)!r}, but the reverse order exists "
+                    f"elsewhere in this module (ABBA deadlock)")
+
+    @staticmethod
+    def _reaches(adj: Dict[LockKey, Set[LockKey]],
+                 src: LockKey, dst: LockKey) -> bool:
+        seen: Set[LockKey] = set()
+        work: List[LockKey] = [src]
+        while work:
+            cur = work.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            work.extend(adj.get(cur, ()))
+        return False

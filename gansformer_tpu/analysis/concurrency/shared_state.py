@@ -1,0 +1,267 @@
+"""unguarded-shared-attribute: cross-thread state without a common lock.
+
+Subsumes (and retires) the old module-literal-only ``thread-shared-state``
+rule.  Two families of findings, both scoped to modules that actually
+construct threads (``ctx.threads``):
+
+* **module-level mutables** — the legacy behaviour, now with transitive
+  thread-reachability: a module dict/list/set mutated from any function
+  reachable from a thread entry point without a lock held;
+* **instance attributes** — inside a class that constructs threads or
+  has thread-reachable methods, an attribute with inconsistent lock
+  discipline: an unlocked read-modify-write (``self.x += 1``,
+  ``self.d[k] = v``, ``self.l.append(…)``) of an attribute shared
+  across functions, or an unlocked write to an attribute that is
+  lock-guarded elsewhere (the torn-publish shape: ``_pop_batch`` writes
+  ``_busy_since`` under ``_cv`` while the supervisor clears it bare).
+
+Sanctioned idioms (never flagged — the allowlist the hint points at):
+
+* **single-writer publish / monotonic flag** — a plain ``self.x = v``
+  with no read-modify-write and no locked access anywhere
+  (``self._error = e`` from a producer thread, ``self._finished =
+  True``): one atomic store, readers tolerate staleness by design;
+* **unlocked reads** — racy reads of monotonic state are the reader's
+  explicit choice; flagging them would bury the writes that tear;
+* **thread-safe primitives** — attributes holding ``Event`` / ``Queue``
+  / locks themselves;
+* **``__init__`` stores** — construction happens-before ``start()``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "appendleft",
+             "popleft"}
+
+
+def _module_mutables(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for st in tree.body:
+        if isinstance(st, ast.Assign) and \
+                isinstance(st.value, _MUTABLE_LITERALS):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None and \
+                isinstance(st.value, _MUTABLE_LITERALS) and \
+                isinstance(st.target, ast.Name):
+            out.add(st.target.id)
+    return out
+
+
+def _is_self_attr(expr: ast.AST) -> bool:
+    return (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self")
+
+
+def _reads_attr(expr: ast.AST, attr: str) -> bool:
+    return any(_is_self_attr(n) and n.attr == attr
+               and isinstance(n.ctx, ast.Load)
+               for n in ast.walk(expr))
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    kind: str            # "read" | "write" | "rmw" | "mutate"
+    node: ast.AST
+    owner: Optional[ast.AST]
+    locked: bool
+    in_init: bool
+
+
+@register
+class UnguardedSharedAttribute(Rule):
+    id = "unguarded-shared-attribute"
+    aliases = ("thread-shared-state",)
+    description = ("state shared across threads written without the lock "
+                   "that guards it elsewhere (absorbs thread-shared-state)")
+    hint = ("guard the write with the lock the other accesses hold "
+            "(with self._lock: …); a plain single-writer publish of an "
+            "immutable value is sanctioned — read-modify-writes and "
+            "container mutations are not")
+    node_types = (ast.Module,)
+
+    def check(self, node: ast.Module, ctx: FileContext) -> None:
+        tm = ctx.threads
+        if not tm.thread_sites:
+            return
+        self._check_module_mutables(node, ctx, tm)
+        for cls in ast.walk(node):
+            if isinstance(cls, ast.ClassDef):
+                self._check_class(cls, ctx, tm)
+
+    # -- module-level mutables (legacy thread-shared-state scope) -----------
+
+    def _check_module_mutables(self, tree: ast.Module, ctx: FileContext,
+                               tm) -> None:
+        mutables = _module_mutables(tree)
+        if not mutables:
+            return
+        for fn in ast.walk(tree):
+            if not isinstance(fn, _FUNC_DEFS + (ast.Lambda,)):
+                continue
+            if not tm.is_thread_reachable(fn):
+                continue
+            for node in tm._own_body(fn):
+                if tm.held_locks(node):
+                    continue
+                self._check_global_stmt(node, mutables, fn, ctx)
+
+    def _check_global_stmt(self, node: ast.AST, mutables: Set[str],
+                           fn: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in mutables:
+                    ctx.report(
+                        self, node,
+                        f"module-level mutable {t.value.id!r} written "
+                        f"from thread-reachable code without holding "
+                        f"a lock")
+                elif isinstance(t, ast.Name) and t.id in mutables and \
+                        self._declared_global(fn, t.id):
+                    ctx.report(
+                        self, node,
+                        f"module-level mutable {t.id!r} rebound from "
+                        f"thread-reachable code without holding a lock")
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in mutables:
+            ctx.report(
+                self, node,
+                f"module-level mutable {node.func.value.id!r}."
+                f"{node.func.attr}() from thread-reachable code without "
+                f"holding a lock")
+
+    @staticmethod
+    def _declared_global(fn: ast.AST, name: str) -> bool:
+        if isinstance(fn, ast.Lambda):
+            return False
+        return any(isinstance(s, ast.Global) and name in s.names
+                   for s in ast.walk(fn))
+
+    # -- instance attributes -------------------------------------------------
+
+    def _check_class(self, cls: ast.ClassDef, ctx: FileContext,
+                     tm) -> None:
+        in_scope = any(
+            tm.enclosing_class(site.node) is cls
+            for site in tm.thread_sites) or any(
+            isinstance(m, _FUNC_DEFS) and tm.is_thread_reachable(m)
+            for m in cls.body)
+        if not in_scope:
+            return
+        accesses = self._collect_accesses(cls, tm)
+        by_attr: Dict[str, List[_Access]] = {}
+        for a in accesses:
+            by_attr.setdefault(a.attr, []).append(a)
+        for attr, accs in sorted(by_attr.items()):
+            key = (cls.name, attr)
+            if key in tm.locks or key in tm.safe_keys:
+                continue     # locks/Events/Queues are their own guards
+            live = [a for a in accs if not a.in_init]
+            owners = {id(a.owner) for a in live if a.owner is not None}
+            shared = (len(owners) >= 2 and any(
+                a.owner is not None and tm.is_thread_reachable(a.owner)
+                for a in live))
+            has_locked = any(a.locked for a in accs)
+            for a in live:
+                if a.locked or a.kind == "read":
+                    continue
+                if a.kind in ("rmw", "mutate") and shared:
+                    what = ("read-modify-write of"
+                            if a.kind == "rmw" else "mutation of")
+                    ctx.report(
+                        self, a.node,
+                        f"unlocked {what} shared attribute "
+                        f"'self.{attr}' in {cls.name} — compound "
+                        f"updates tear across threads")
+                elif has_locked:
+                    ctx.report(
+                        self, a.node,
+                        f"unlocked write to 'self.{attr}' in "
+                        f"{cls.name}, which is lock-guarded elsewhere "
+                        f"— inconsistent discipline hides a torn "
+                        f"publish")
+
+    def _collect_accesses(self, cls: ast.ClassDef, tm) -> List[_Access]:
+        out: List[_Access] = []
+
+        def add(attr: str, kind: str, node: ast.AST) -> None:
+            owner = self._owner(node, tm)
+            if owner is None or tm.enclosing_class(owner) is not cls:
+                return   # class-body defaults / an inner class's code
+            in_init = (isinstance(owner, _FUNC_DEFS)
+                       and owner.name == "__init__"
+                       and not tm.is_entry(owner))
+            out.append(_Access(attr, kind, node, owner,
+                               bool(tm.held_locks(node)), in_init))
+
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for t in self._flat_targets(node.targets):
+                    if _is_self_attr(t):
+                        kind = "rmw" if _reads_attr(node.value, t.attr) \
+                            else "write"
+                        add(t.attr, kind, node)
+                    elif isinstance(t, ast.Subscript) and \
+                            _is_self_attr(t.value):
+                        add(t.value.attr, "mutate", node)
+            elif isinstance(node, ast.AugAssign):
+                if _is_self_attr(node.target):
+                    add(node.target.attr, "rmw", node)
+                elif isinstance(node.target, ast.Subscript) and \
+                        _is_self_attr(node.target.value):
+                    add(node.target.value.attr, "mutate", node)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS and \
+                    _is_self_attr(node.func.value):
+                add(node.func.value.attr, "mutate", node)
+            elif _is_self_attr(node) and isinstance(node.ctx, ast.Load):
+                add(node.attr, "read", node)
+        return out
+
+    @staticmethod
+    def _flat_targets(targets: List[ast.AST]) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                out.extend(t.elts)
+            else:
+                out.append(t)
+        return out
+
+    @staticmethod
+    def _owner(node: ast.AST, tm) -> Optional[ast.AST]:
+        """The function whose execution context an access runs in:
+        nested helpers collapse into their enclosing method (they are
+        called synchronously) — unless the nested function is itself a
+        thread entry (a ``_produce`` closure target), which anchors its
+        own context."""
+        fn = tm.enclosing_function(node)
+        if fn is None:
+            return None
+        while not tm.is_entry(fn):
+            up = tm.enclosing_function(fn)
+            if up is None:
+                break
+            fn = up
+        return fn

@@ -1,0 +1,121 @@
+"""signal-handler-safety: handlers must stay async-signal-safe-ish.
+
+CPython runs signal handlers on the MAIN thread at an arbitrary
+bytecode boundary — possibly while that very thread holds a lock the
+handler wants (the non-reentrant deadlock ``GenerationService``
+documents in ``install_signal_drain``), possibly mid-allocation.  So a
+function registered via ``signal.signal`` (directly, or inside an
+installer like ``install_signal_drain``) must limit itself to the
+sanctioned idiom: set a flag, ``os.write``/``os.kill``, poke a
+subprocess, or hand the real work to a separate thread
+(``threading.Thread(target=…).start()`` — the drain-thread pattern).
+
+Flagged inside a resolved handler (transitively through in-module
+calls; thread *targets* constructed by the handler are exempt — they
+run elsewhere, which is the point):
+
+* acquiring any lock (``with lock:`` / ``.acquire()``);
+* calling into jax (``jax.*``/``jnp.*`` — allocation, device sync);
+* non-reentrant / blocking IO: ``print``, ``open``, ``logging.*``,
+  ``time.sleep``, and blocking ``.join(…)``/``.wait(…)`` calls.
+
+Handlers that cannot be resolved (a name imported from elsewhere, the
+restore path ``signal.signal(sig, old_handler)``) are skipped — the
+rule checks definitions it can see, the resolver summary records the
+registration either way.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+from gansformer_tpu.analysis.jit_regions import dotted_name
+
+_BLOCKING = {"print", "open", "time.sleep"}
+_BLOCKING_ATTRS = {"join", "wait"}
+
+
+@register
+class SignalHandlerSafety(Rule):
+    id = "signal-handler-safety"
+    description = ("signal handler acquires a lock, calls into jax, or "
+                   "performs non-reentrant IO")
+    hint = ("a handler may only set flags, os.write/os.kill, poke a "
+            "subprocess, or defer to a thread "
+            "(threading.Thread(target=…).start()) — it interrupts the "
+            "main thread at an arbitrary bytecode boundary, possibly "
+            "while a lock it wants is already held")
+    node_types = (ast.Module,)
+
+    def check(self, node: ast.Module, ctx: FileContext) -> None:
+        tm = ctx.threads
+        for handler in tm.handlers:
+            for target in handler.targets:
+                self._scan(target, handler.target_desc, ctx, tm)
+
+    def _scan(self, root: ast.AST, hname: str, ctx: FileContext,
+              tm) -> None:
+        seen: Set[int] = set()
+        work = [root]
+        while work:
+            fn = work.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            for n in tm._own_body(fn):
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    for item in n.items:
+                        key = tm.lock_key(item.context_expr, n)
+                        if key is not None:
+                            ctx.report(
+                                self, n,
+                                f"signal handler {hname!r} acquires "
+                                f"lock {key[1]!r} — the interrupted "
+                                f"main thread may already hold it "
+                                f"(non-reentrant deadlock)")
+                elif isinstance(n, ast.Call):
+                    self._check_call(n, hname, ctx, tm)
+                    # follow in-module callees: the violation may hide
+                    # one helper down (thread targets are ARGS, not
+                    # Call.func — never followed, by construction)
+                    work.extend(tm.resolve_callable(n.func, n))
+
+    def _check_call(self, call: ast.Call, hname: str, ctx: FileContext,
+                    tm) -> None:
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr == "acquire":
+                key = tm.lock_key(call.func.value, call)
+                if key is not None:
+                    ctx.report(
+                        self, call,
+                        f"signal handler {hname!r} acquires lock "
+                        f"{key[1]!r} — the interrupted main thread may "
+                        f"already hold it (non-reentrant deadlock)")
+                    return
+            if call.func.attr in _BLOCKING_ATTRS:
+                name = dotted_name(call.func.value) or "<expr>"
+                ctx.report(
+                    self, call,
+                    f"signal handler {hname!r} blocks on "
+                    f"{name}.{call.func.attr}() — a handler must "
+                    f"return promptly; defer the wait to a drain "
+                    f"thread")
+                return
+        name = dotted_name(call.func)
+        if not name:
+            return
+        root = name.split(".")[0]
+        if root in ("jax", "jnp"):
+            ctx.report(
+                self, call,
+                f"signal handler {hname!r} calls {name}() — jax "
+                f"allocation/dispatch inside a handler can deadlock "
+                f"the runtime it interrupted")
+        elif name in _BLOCKING or root == "logging":
+            ctx.report(
+                self, call,
+                f"signal handler {hname!r} performs non-reentrant IO "
+                f"via {name}() — use os.write or set a flag and "
+                f"handle it on the main loop")

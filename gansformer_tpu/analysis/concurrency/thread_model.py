@@ -1,0 +1,487 @@
+"""Shared thread-model resolver (the concurrency twin of jit_regions).
+
+Answers, per module, the questions every concurrency rule needs:
+
+* **which threads exist** — every ``threading.Thread(target=…)`` and
+  ``LoopWorker(fn, …)`` construction, plus every ``X.submit(fn)``
+  dispatch onto a background executor (``SingleSlotWriter`` and
+  anything with the same shape), with the construction's binding
+  (``self._thread = …`` / ``t = …`` / fire-and-forget) and its
+  ``daemon`` flag;
+* **what runs on them** — each target resolved to its definition(s):
+  bare name module-wide, ``self.method`` to the enclosing class's
+  method, ``lambda`` to the lambda node itself, and one
+  ``functools.partial(f, …)`` layer; membership then propagates
+  transitively exactly like the jit-region index — a function
+  referenced by bare name or as ``self.method`` from thread-entered
+  code is thread-reachable too;
+* **which locks exist** — assignments of ``threading.Lock`` / ``RLock``
+  / ``Condition`` / ``Semaphore`` results, keyed ``(class, attr)`` for
+  ``self._lock = …`` and ``("", name)`` for module-level locks, plus
+  thread-safe primitives (``Event``, ``queue.Queue``) the shared-state
+  rule must NOT flag;
+* **which signal handlers are installed** — ``signal.signal(SIG, h)``
+  registrations with ``h`` resolved like a thread target.
+
+Known limits (documented in docs/static-analysis.md): resolution is
+name-based and module-local — a target held by a non-``self`` receiver
+(``srv.serve_forever``) or imported from another module is recorded but
+unresolved, and cross-instance aliasing (two ``Ticket`` objects' locks)
+collapses onto one ``(class, attr)`` key, which is exactly what the
+lock-order rule wants for self-deadlock shapes and an over-approximation
+everywhere else.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from gansformer_tpu.analysis.jit_regions import dotted_name
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# constructor last-name -> lock kind
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition",
+              "Semaphore": "semaphore", "BoundedSemaphore": "semaphore"}
+# thread-safe primitives: never "unguarded shared state"
+SAFE_CTORS = {"Event", "Queue", "SimpleQueue", "LifoQueue",
+              "PriorityQueue", "Barrier", "local"}
+# reentrant-safe lock kinds (self-reacquisition is legal)
+REENTRANT_KINDS = {"rlock"}
+
+# LockKey: ("" or class name, attribute/variable name)
+LockKey = Tuple[str, str]
+
+
+def lockish_name(name: str) -> bool:
+    """Heuristic for lock objects the module did not construct itself
+    (a lock passed in as a parameter, e.g. obs/registry instruments):
+    the repo's naming convention makes these recognizable."""
+    last = name.lower()
+    return ("lock" in last or "cond" in last or last in ("_cv", "cv")
+            or "semaphore" in last or "mutex" in last)
+
+
+@dataclasses.dataclass
+class ThreadSite:
+    kind: str                       # "Thread" | "LoopWorker" | "submit"
+    node: ast.Call                  # the construction / dispatch call
+    target_desc: str                # human-readable target expression
+    targets: Tuple[ast.AST, ...]    # resolved defs / lambda nodes
+    daemon: Optional[bool]          # the daemon= kwarg, when constant
+    binding: Optional[Tuple[str, str, str]]  # ("attr",cls,name)|("name","",n)
+
+
+@dataclasses.dataclass
+class LockSite:
+    key: LockKey
+    kind: str                       # lock | rlock | condition | semaphore
+    node: ast.AST                   # the constructing assignment
+
+
+@dataclasses.dataclass
+class HandlerSite:
+    node: ast.Call                  # the signal.signal(...) call
+    target_desc: str
+    targets: Tuple[ast.AST, ...]
+
+
+def _describe(expr: ast.AST) -> str:
+    name = dotted_name(expr)
+    if name:
+        return name
+    if isinstance(expr, ast.Lambda):
+        return "<lambda>"
+    if isinstance(expr, ast.Call):
+        inner = dotted_name(expr.func)
+        return f"{inner}(...)" if inner else "<call>"
+    return f"<{type(expr).__name__}>"
+
+
+class ThreadModel:
+    """Per-module thread/lock/handler index (built once, shared across
+    the concurrency rules via ``ctx.threads``)."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self._parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        # bare-name def index: module-level and nested (closure) defs.
+        # Direct class-body methods are excluded — a bare name never
+        # reaches them (they need a receiver), and a method named after
+        # a builtin (Gauge.max) must not capture calls to that builtin.
+        self._defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNC_DEFS) and \
+                    not isinstance(self._parents.get(id(node)),
+                                   ast.ClassDef):
+                self._defs_by_name.setdefault(node.name, []).append(node)
+        # class name -> {method name -> [def nodes]} (direct body only)
+        self._methods: Dict[str, Dict[str, List[ast.AST]]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                table = self._methods.setdefault(node.name, {})
+                for m in node.body:
+                    if isinstance(m, _FUNC_DEFS):
+                        table.setdefault(m.name, []).append(m)
+
+        self.locks: Dict[LockKey, LockSite] = {}
+        self.safe_keys: Set[LockKey] = set()
+        self._collect_locks()
+
+        self.thread_sites: List[ThreadSite] = []
+        self.handlers: List[HandlerSite] = []
+        self._collect_sites()
+
+        self._entry_ids: Set[int] = set()
+        self._reachable_ids: Set[int] = set()
+        self._propagate([t for s in self.thread_sites for t in s.targets])
+
+    # -- tree helpers --------------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        n = self.parent(node)
+        while n is not None:
+            if isinstance(n, ast.ClassDef):
+                return n
+            n = self.parent(n)
+        return None
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        n = self.parent(node)
+        while n is not None:
+            if isinstance(n, _FUNC_DEFS + (ast.Lambda,)):
+                return n
+            if isinstance(n, ast.ClassDef):
+                return None
+            n = self.parent(n)
+        return None
+
+    def qualname(self, fn: ast.AST) -> str:
+        if isinstance(fn, ast.Lambda):
+            base = "<lambda>"
+        else:
+            base = fn.name
+        cls = self.enclosing_class(fn)
+        return f"{cls.name}.{base}" if cls is not None else base
+
+    # -- target / lock resolution -------------------------------------------
+
+    def resolve_callable(self, expr: ast.AST,
+                         at: ast.AST) -> Tuple[ast.AST, ...]:
+        """Defs/lambdas an expression used as a callable refers to."""
+        if isinstance(expr, ast.Name):
+            return tuple(self._defs_by_name.get(expr.id, ()))
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            cls = self.enclosing_class(at)
+            if cls is not None:
+                return tuple(self._methods.get(cls.name, {})
+                             .get(expr.attr, ()))
+            return ()
+        if isinstance(expr, ast.Lambda):
+            return (expr,)
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func)
+            if name and name.split(".")[-1] == "partial" and expr.args:
+                return self.resolve_callable(expr.args[0], at)
+        return ()
+
+    def lock_key(self, expr: ast.AST,
+                 at: ast.AST) -> Optional[LockKey]:
+        """The canonical key of a lock-valued expression, or None when
+        the expression is not recognizably a lock.  Recorded
+        constructions match exactly; un-constructed names fall back to
+        the naming heuristic (a lock received as a parameter)."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            cls = self.enclosing_class(at)
+            key = (cls.name if cls is not None else "", expr.attr)
+            if key in self.locks or lockish_name(expr.attr):
+                return key
+            return None
+        name = dotted_name(expr)
+        if name and "." not in name:
+            key = ("", name)
+            if key in self.locks or lockish_name(name):
+                return key
+        return None
+
+    def lock_kind(self, key: LockKey) -> str:
+        site = self.locks.get(key)
+        return site.kind if site is not None else "lock"
+
+    def held_locks(self, node: ast.AST) -> List[LockKey]:
+        """Lock keys lexically held at ``node`` (enclosing ``with``
+        statements whose context expressions are locks), innermost
+        last."""
+        chain: List[LockKey] = []
+        n = self.parent(node)
+        child: ast.AST = node
+        while n is not None:
+            # a node inside the context expression itself (child is the
+            # withitem, not a body statement) does not yet hold the lock
+            if isinstance(n, (ast.With, ast.AsyncWith)) and \
+                    not isinstance(child, ast.withitem):
+                for item in n.items:
+                    key = self.lock_key(item.context_expr, n)
+                    if key is not None:
+                        chain.append(key)
+            child, n = n, self.parent(n)
+        chain.reverse()
+        return chain
+
+    def acquisitions(self, fn: ast.AST,
+                     transitive: bool = False) -> Set[LockKey]:
+        """Lock keys ``fn`` acquires — lexical ``with`` items and
+        ``.acquire()`` calls in its own body (nested defs excluded);
+        ``transitive`` adds everything reachable through resolvable
+        in-module calls."""
+        out: Set[LockKey] = set()
+        seen: Set[int] = set()
+        work = [fn]
+        while work:
+            cur = work.pop()
+            if id(cur) in seen:
+                continue
+            seen.add(id(cur))
+            for node in self._own_body(cur):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        key = self.lock_key(item.context_expr, node)
+                        if key is not None:
+                            out.add(key)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire":
+                    key = self.lock_key(node.func.value, node)
+                    if key is not None:
+                        out.add(key)
+                elif transitive and isinstance(node, ast.Call):
+                    work.extend(self.resolve_callable(node.func, node))
+        return out
+
+    # -- thread reachability -------------------------------------------------
+
+    def is_entry(self, fn: ast.AST) -> bool:
+        """Is this def/lambda a direct thread target?"""
+        return id(fn) in self._entry_ids
+
+    def is_thread_reachable(self, fn: ast.AST) -> bool:
+        """Entry, or transitively referenced from one."""
+        return id(fn) in self._reachable_ids
+
+    def _own_body(self, fn: ast.AST):
+        """Nodes of a def/lambda body, nested function bodies excluded
+        (they run on their own call, and propagate on their own turn)."""
+        roots = [fn.body] if isinstance(fn, ast.Lambda) else list(fn.body)
+        stack = list(roots)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, _FUNC_DEFS + (ast.Lambda,)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _refs(self, fn: ast.AST) -> Tuple[Set[str], Set[str]]:
+        """(bare names loaded, attribute names on ``self``) in the
+        def's own body."""
+        names: Set[str] = set()
+        self_attrs: Set[str] = set()
+        for node in self._own_body(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                names.add(node.id)
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                self_attrs.add(node.attr)
+        return names, self_attrs
+
+    def _propagate(self, entries: Sequence[ast.AST]) -> None:
+        self._entry_ids = {id(e) for e in entries}
+        work = list(entries)
+        while work:
+            fn = work.pop()
+            if id(fn) in self._reachable_ids:
+                continue
+            self._reachable_ids.add(id(fn))
+            names, self_attrs = self._refs(fn)
+            targets: List[ast.AST] = []
+            for name in names:
+                targets.extend(self._defs_by_name.get(name, ()))
+            cls = self.enclosing_class(fn)
+            if cls is not None:
+                table = self._methods.get(cls.name, {})
+                for attr in self_attrs:
+                    targets.extend(table.get(attr, ()))
+            for t in targets:
+                if id(t) not in self._reachable_ids:
+                    work.append(t)
+
+    # -- collection ----------------------------------------------------------
+
+    def _collect_locks(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not isinstance(value, ast.Call):
+                continue
+            ctor = dotted_name(value.func).split(".")[-1]
+            for t in targets:
+                key: Optional[LockKey] = None
+                if isinstance(t, ast.Name):
+                    key = ("", t.id)
+                elif isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    cls = self.enclosing_class(node)
+                    key = (cls.name if cls is not None else "", t.attr)
+                if key is None:
+                    continue
+                if ctor in LOCK_CTORS:
+                    self.locks.setdefault(
+                        key, LockSite(key, LOCK_CTORS[ctor], node))
+                elif ctor in SAFE_CTORS:
+                    self.safe_keys.add(key)
+
+    def _thread_target_expr(self, call: ast.Call,
+                            kind: str) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        if kind in ("LoopWorker", "submit") and call.args:
+            return call.args[0]
+        return None
+
+    def _binding_of(self, call: ast.Call):
+        """('attr', class, name) / ('name', '', name) for constructions
+        assigned somewhere — following ``.start()`` chains like
+        ``self._w = LoopWorker(...).start()`` — else None."""
+        node: ast.AST = call
+        p = self.parent(node)
+        while p is not None and (
+                (isinstance(p, ast.Attribute) and p.value is node)
+                or (isinstance(p, ast.Call) and p.func is node)):
+            node, p = p, self.parent(p)
+        if isinstance(p, ast.Assign) and p.value is node:
+            t = p.targets[0]
+            if isinstance(t, ast.Name):
+                return ("name", "", t.id)
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                cls = self.enclosing_class(p)
+                return ("attr", cls.name if cls is not None else "", t.attr)
+        return None
+
+    def _collect_sites(self) -> None:
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted_name(call.func)
+            last = name.split(".")[-1] if name else ""
+            if name == "signal.signal" and len(call.args) >= 2:
+                expr = call.args[1]
+                self.handlers.append(HandlerSite(
+                    call, _describe(expr),
+                    self.resolve_callable(expr, call)))
+                continue
+            kind = None
+            if last == "Thread":
+                kind = "Thread"
+            elif last == "LoopWorker":
+                kind = "LoopWorker"
+            elif isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "submit":
+                kind = "submit"
+            if kind is None:
+                continue
+            expr = self._thread_target_expr(call, kind)
+            if expr is None:
+                continue
+            targets = self.resolve_callable(expr, call)
+            if kind == "submit" and not targets:
+                # an unresolvable .submit() is some other API (e.g. a
+                # futures executor over imported fns) — recording it
+                # would only add noise with zero reachable code
+                continue
+            daemon: Optional[bool] = None
+            for kw in call.keywords:
+                if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                    daemon = bool(kw.value.value)
+            self.thread_sites.append(ThreadSite(
+                kind, call, _describe(expr), targets, daemon,
+                self._binding_of(call)))
+
+    # -- export ---------------------------------------------------------------
+
+    def summary(self) -> dict:
+        """JSON-ready per-module summary (threads discovered, locks,
+        entry-point mapping, signal handlers) — the ``--format json``
+        thread_model section the doctor and elasticity work consume."""
+        threads = []
+        for s in self.thread_sites:
+            threads.append({
+                "kind": s.kind, "line": s.node.lineno,
+                "target": s.target_desc,
+                "resolved": sorted(self.qualname(t) for t in s.targets),
+                "daemon": s.daemon,
+                "bound_to": (f"self.{s.binding[2]}"
+                             if s.binding and s.binding[0] == "attr"
+                             else s.binding[2] if s.binding else None),
+            })
+        locks = [{"name": key[1], "class": key[0] or None,
+                  "kind": site.kind, "line": site.node.lineno}
+                 for key, site in sorted(self.locks.items())]
+        handlers = [{"line": h.node.lineno, "handler": h.target_desc,
+                     "resolved": sorted(self.qualname(t)
+                                        for t in h.targets)}
+                    for h in self.handlers]
+        reachable = sorted({self.qualname(t) for t in ast.walk(self.tree)
+                            if isinstance(t, _FUNC_DEFS)
+                            and self.is_thread_reachable(t)})
+        return {"threads": threads, "locks": locks,
+                "signal_handlers": handlers,
+                "thread_reachable": reachable}
+
+
+def summarize_paths(paths: Sequence[str], root: str = ".") -> dict:
+    """Aggregate thread-model summaries over ``paths`` (python files) —
+    files without threads/locks/handlers are elided to keep the
+    artifact small; unparseable files are skipped (the lint run itself
+    reports the parse error)."""
+    import os
+
+    files = []
+    totals = {"threads": 0, "locks": 0, "signal_handlers": 0}
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        model = ThreadModel(tree)
+        s = model.summary()
+        if not (s["threads"] or s["locks"] or s["signal_handlers"]):
+            continue
+        try:
+            rel = os.path.relpath(os.path.abspath(path),
+                                  os.path.abspath(root))
+        except ValueError:
+            rel = path
+        files.append({"path": rel.replace(os.sep, "/"), **s})
+        totals["threads"] += len(s["threads"])
+        totals["locks"] += len(s["locks"])
+        totals["signal_handlers"] += len(s["signal_handlers"])
+    return {"files": files,
+            "totals": {**totals, "files_with_threads": len(files)}}

@@ -31,18 +31,23 @@ _SUPPRESS_FILE_RE = re.compile(
 class Rule:
     """Base class.  Subclasses set ``id``/``description``/``hint`` and
     ``node_types`` (the ast classes they subscribe to), and implement
-    ``check(node, ctx)`` calling ``ctx.report(self, node, message)``."""
+    ``check(node, ctx)`` calling ``ctx.report(self, node, message)``.
+    ``aliases`` lists RETIRED ids this rule subsumes: old
+    ``# graftlint: disable=`` comments, baseline keys, and
+    ``--select``/``--ignore`` spellings keep working through them."""
 
     id: str = ""
     description: str = ""
     hint: str = ""
     node_types: Sequence[type] = ()
+    aliases: Sequence[str] = ()
 
     def check(self, node: ast.AST, ctx: "FileContext") -> None:
         raise NotImplementedError
 
 
 _REGISTRY: Dict[str, Type[Rule]] = {}
+_ALIASES: Dict[str, str] = {}     # retired id -> current rule id
 
 
 def register(cls: Type[Rule]) -> Type[Rule]:
@@ -51,22 +56,45 @@ def register(cls: Type[Rule]) -> Type[Rule]:
         raise ValueError(f"rule {cls.__name__} has no id")
     if _REGISTRY.get(cls.id, cls) is not cls:
         raise ValueError(f"duplicate rule id {cls.id!r}")
+    for alias in cls.aliases:
+        if alias in _REGISTRY or _ALIASES.get(alias, cls.id) != cls.id:
+            raise ValueError(f"alias {alias!r} of {cls.id!r} collides "
+                             f"with an existing rule id/alias")
+        _ALIASES[alias] = cls.id
     _REGISTRY[cls.id] = cls
     return cls
 
 
-def all_rules() -> List[Type[Rule]]:
-    """Every registered rule class, importing the bundled rule set on
-    first use (rules register at import time)."""
+def _import_rule_packages() -> None:
+    import gansformer_tpu.analysis.concurrency  # noqa: F401  (registers)
     import gansformer_tpu.analysis.rules  # noqa: F401  (registers)
 
+
+def all_rules() -> List[Type[Rule]]:
+    """Every registered rule class, importing the bundled rule sets on
+    first use (rules register at import time)."""
+    _import_rule_packages()
     return [_REGISTRY[k] for k in sorted(_REGISTRY)]
 
 
 def get_rule(rule_id: str) -> Type[Rule]:
-    import gansformer_tpu.analysis.rules  # noqa: F401
+    """Look up a rule by id — retired aliases resolve to their
+    successor (``thread-shared-state`` → unguarded-shared-attribute)."""
+    _import_rule_packages()
+    return _REGISTRY[_ALIASES.get(rule_id, rule_id)]
 
-    return _REGISTRY[rule_id]
+
+def rule_aliases() -> Dict[str, str]:
+    """{retired id: current id} for every registered alias."""
+    _import_rule_packages()
+    return dict(_ALIASES)
+
+
+def legacy_ids(rule_id: str) -> List[str]:
+    """Retired ids that now map to ``rule_id`` (for baseline-key
+    compatibility: an old baseline entry keyed by the retired id still
+    absolves the successor rule's finding on the same line)."""
+    return sorted(a for a, cur in _ALIASES.items() if cur == rule_id)
 
 
 def _parse_suppressions(lines: Sequence[str]):
@@ -102,6 +130,7 @@ class FileContext:
             for child in ast.iter_child_nodes(parent):
                 self._parents[id(child)] = parent
         self._jit = None
+        self._threads = None
         self._seen: Set[tuple] = set()
 
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
@@ -115,6 +144,17 @@ class FileContext:
 
             self._jit = JitIndex(self.tree)
         return self._jit
+
+    @property
+    def threads(self):
+        """Lazily-built thread-model index (shared across the
+        concurrency rules — analysis/concurrency/thread_model.py)."""
+        if self._threads is None:
+            from gansformer_tpu.analysis.concurrency.thread_model import (
+                ThreadModel)
+
+            self._threads = ThreadModel(self.tree)
+        return self._threads
 
     def line_text(self, line: int) -> str:
         return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
@@ -137,10 +177,12 @@ class FileContext:
         if key in self._seen:
             return None
         self._seen.add(key)
+        suppressed = any(self.is_suppressed(rid, line)
+                         for rid in (rule.id, *rule.aliases))
         f = Finding(rule=rule.id, path=self.path, line=line, col=col,
                     message=message,
                     hint=rule.hint if hint is None else hint,
-                    suppressed=self.is_suppressed(rule.id, line))
+                    suppressed=suppressed)
         self.findings.append(f)
         return f
 

@@ -7,10 +7,15 @@ One module per rule (see docs/static-analysis.md for the catalog):
 * ``rng_reuse``       — rng-key-reuse
 * ``hot_loop``        — hot-loop-sync (migrated from
                         scripts/check_hot_loop.py, which is now a shim)
-* ``thread_state``    — thread-shared-state
 * ``telemetry_names`` — telemetry-name-convention
 * ``retrace_static``  — retrace-static (the AST companion of the
                         jaxpr-level retrace-hazard trace rule, ISSUE 4)
+
+The old ``thread_state`` module (thread-shared-state) is RETIRED into
+``analysis/concurrency/shared_state.py`` (ISSUE 18) — the id survives
+as an alias of ``unguarded-shared-attribute``, so existing
+``# graftlint: disable=thread-shared-state`` comments, baseline keys,
+and ``--select`` spellings keep working.
 """
 
 from gansformer_tpu.analysis.rules import (  # noqa: F401
@@ -20,5 +25,4 @@ from gansformer_tpu.analysis.rules import (  # noqa: F401
     retrace_static,
     rng_reuse,
     telemetry_names,
-    thread_state,
 )

@@ -626,8 +626,13 @@ class GenerationService:
                 self._gen += 1
                 self._cv.notify_all()
             self._fail_inflight(err)
-            self._busy_since = None
-            self._busy_cold = False
+            with self._cv:
+                # every other write of this pair goes through _cv (the
+                # pop/_finish_batch paths); an unlocked reset here let
+                # the hang detector sample a half-reset pair and
+                # re-flag an already-abandoned worker as hung
+                self._busy_since = None
+                self._busy_cold = False
             telemetry.gauge("serve/dispatcher_alive").set(0)
             # Progress resets the escalation (the supervisor.py shape):
             # a dispatcher that served batches between deaths restarts
@@ -656,7 +661,10 @@ class GenerationService:
                                max(0.0, deadline - time.monotonic())))
             # counted HERE, after the trip check AND the stay-down
             # exit: a restart is a REPLACEMENT WORKER, nothing less
-            self._restarts += 1
+            # (under _cv: health() reads it from request threads, and
+            # an unlocked += tears against them)
+            with self._cv:
+                self._restarts += 1
             telemetry.counter("serve/dispatcher_restarts_total").inc()
             self._worker = LoopWorker(self._serve_dispatch,
                                       "serve/dispatch").start()
@@ -788,10 +796,16 @@ class GenerationService:
                         rt.event(t.rid, "wcache_hit")
                 # a batch that will pay a lazy cold compile gets the
                 # hang watchdog's startup grace, not the steady budget
-                self._busy_cold = (
+                cold = (
                     not programs.is_compiled("synthesize", bucket)
                     or bool(miss) and not programs.is_compiled(
                         "map_seeds", self._select_bucket(len(miss))))
+                with self._cv:
+                    # publish under _cv: the supervisor samples
+                    # (_busy_since, _busy_cold) as a pair, and an
+                    # unlocked write here could pair a fresh cold flag
+                    # with the PREVIOUS batch's start time
+                    self._busy_cold = cold
                 psi = np.full((bucket,), 1.0, np.float32)
                 psi[:n] = [t.psi for t in batch]
                 noise = np.array([self._noise_seed, self._batches],
@@ -870,8 +884,13 @@ class GenerationService:
                         # cancelled while in flight: computed but not
                         # delivered — count the cancel, not an image
                         telemetry.counter("serve/cancelled_total").inc()
-                self._fulfilled += 1
                 with self._cv:
+                    # _fulfilled is the supervisor's progress signal
+                    # and the watchdog's cold-start gate; keep the
+                    # compound += under _cv with the rest of the batch
+                    # bookkeeping so those readers never see a torn
+                    # update
+                    self._fulfilled += 1
                     # this batch proved both executables it used —
                     # reset their consecutive-failure counts
                     self._bucket_fails.pop(bucket, None)

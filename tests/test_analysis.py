@@ -14,7 +14,11 @@ from gansformer_tpu.analysis.reporters import render_json, render_text
 
 EXPECTED_RULES = {
     "host-sync-in-jit", "donation-after-use", "rng-key-reuse",
-    "hot-loop-sync", "thread-shared-state", "telemetry-name-convention",
+    "hot-loop-sync", "telemetry-name-convention",
+    # the concurrency pass (ISSUE 18) — unguarded-shared-attribute
+    # absorbs the retired thread-shared-state rule
+    "unguarded-shared-attribute", "lock-order-inversion",
+    "thread-lifecycle", "signal-handler-safety", "condition-protocol",
 }
 
 BAD_RNG = """\
@@ -30,7 +34,7 @@ def f(seed):
 
 # --- registry / engine ------------------------------------------------------
 
-def test_registry_contains_the_six_rules():
+def test_registry_contains_the_expected_rules():
     ids = {r.id for r in all_rules()}
     assert EXPECTED_RULES <= ids
     for r in all_rules():

@@ -186,7 +186,7 @@ CASES = [
     ("donation-after-use", DONATION_BAD, DONATION_OK),
     ("rng-key-reuse", RNG_BAD, RNG_OK),
     ("hot-loop-sync", HOT_LOOP_BAD, HOT_LOOP_OK),
-    ("thread-shared-state", THREAD_BAD, THREAD_OK),
+    ("unguarded-shared-attribute", THREAD_BAD, THREAD_OK),
     ("telemetry-name-convention", TELEMETRY_BAD, TELEMETRY_OK),
     ("retrace-static", RETRACE_STATIC_BAD, RETRACE_STATIC_OK),
 ]
@@ -500,7 +500,9 @@ def run(state):
     assert len(findings) == 1 and "state" in findings[0].message
 
 
-def test_thread_state_bare_function_target():
+def test_shared_state_bare_function_target():
+    # run through the RETIRED alias on purpose: thread-shared-state
+    # must keep resolving to unguarded-shared-attribute (ISSUE 18)
     src = """
 import threading
 
@@ -513,6 +515,7 @@ t = threading.Thread(target=_worker)
 """
     findings = run_rule("thread-shared-state", src)
     assert len(findings) == 1 and "_LOG" in findings[0].message
+    assert findings[0].rule == "unguarded-shared-attribute"
 
 
 def test_telemetry_fstring_fragments_checked():
