@@ -127,7 +127,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also run the jaxpr-level trace rules against the "
                         "repo's real jitted entry points (retrace hazards, "
                         "const bloat, dtype promotion, sharding/contract/"
-                        "collective audits)")
+                        "collective audits, and the graftnum numerics "
+                        "rules: fp32-island contracts, accumulation "
+                        "width, unstable primitives)")
     p.add_argument("--trace-profile",
                    choices=("structural", "contracts", "fast", "full"),
                    default="fast",
@@ -197,6 +199,10 @@ def run_trace_findings(profile: str, trace_rules, native: bool = False):
         "mesh_sizes_requested": list(ctx.mesh_sizes),
         "mesh_sizes_compiled": sorted(ctx.meshes_compiled),
         "notes": list(ctx.notes),
+        # graftnum (ISSUE 19): per-entry fp32-island audit records —
+        # the positive "the declared islands compute in fp32 in the
+        # compiled programs" claim, entry by entry
+        "numerics": list(ctx.numerics),
     }
     return findings, payload
 

@@ -67,6 +67,7 @@ def register(cls: Type[Rule]) -> Type[Rule]:
 
 def _import_rule_packages() -> None:
     import gansformer_tpu.analysis.concurrency  # noqa: F401  (registers)
+    import gansformer_tpu.analysis.numerics  # noqa: F401  (registers)
     import gansformer_tpu.analysis.rules  # noqa: F401  (registers)
 
 

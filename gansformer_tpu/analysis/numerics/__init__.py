@@ -1,0 +1,34 @@
+"""graftnum — the numerics analysis layer (ISSUE 19).
+
+Importing this package registers both halves into the shared graftlint
+stacks (one module per rule, the ``analysis/rules`` convention; see
+docs/static-analysis.md "Numerics catalog"):
+
+AST half (``analysis/engine.py`` registry — jax-free):
+
+* ``eps_dtype``           — eps-dtype-mismatch
+
+jaxpr half (``analysis/trace/base.py`` registry; structural — runs on
+every entry of every trace profile, pre-commit's ``contracts`` profile
+included):
+
+* ``island_contract``     — fp32-island-contract (audits
+                            ``contracts.NUMERIC_CONTRACTS``, the dtype
+                            twin of parallel/contracts.ENTRY_CONTRACTS)
+* ``reduction_accum``     — reduction-accumulation
+* ``unstable_primitive``  — unstable-primitive
+
+``dtypes.py`` carries the machine-epsilon/threshold tables (shared
+with tests/tolerances.py); ``jaxpr_util.py`` the dataflow searches.
+Everything imports jax lazily — the package itself loads in jax-free
+environments (the pre-commit AST hooks).
+"""
+
+from gansformer_tpu.analysis.numerics import (  # noqa: F401
+    contracts,
+    dtypes,
+    eps_dtype,
+    island_contract,
+    reduction_accum,
+    unstable_primitive,
+)

@@ -1,0 +1,147 @@
+"""Declared fp32-island contracts — the numeric twin of
+``parallel/contracts.ENTRY_CONTRACTS`` (ISSUE 19).
+
+The bf16 training path survives on hand-placed fp32 islands: the
+instance-norm statistics in ``models/attention.py``, the demodulation
+sum-of-squares/rsqrt in ``ops/modulated_conv.py`` (and its Pallas
+kernels), the attention softmax/lse in ``ops/attention.py`` /
+``ops/pallas_attention.py``, the loss and penalty reductions in
+``losses/gan.py``, and the optimizer moments.  None of that intent was
+written down anywhere a tool could check — this table declares it per
+entry point, and ``analysis/trace/`` rule ``fp32-island-contract``
+audits the *compiled* programs against it (the graftcomms declared-
+contract→compiled-audit shape applied to dtypes).
+
+Islands are matched in the traced jaxpr by (user-frame anchor,
+primitive set): an equation whose user frame lands in one of the
+island's anchor (file, function) pairs and whose primitive is in the
+island's set belongs to the island and must compute on float32
+operands.  Library formulations anchor correctly because
+``source_info_util.user_frame`` skips jax-internal frames — the
+``jax.nn.softmax`` reductions inside ``multihead_attention`` anchor at
+the repo call line, in that function.
+
+Kept import-light: ``parallel/contracts`` pulls jax at module import,
+so ``short_entry_name`` is imported lazily — the AST half of graftlint
+must keep working in jax-free environments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Island:
+    """One fp32 computation the narrow-dtype path depends on.
+
+    ``anchors`` are (path suffix, function name) pairs; ``None`` as the
+    function matches any function in that file (the Pallas kernel
+    modules, where the island spans several kernel bodies).  An island
+    may list several anchors when backends move the same math
+    (xla attention vs the Pallas kernels).
+    """
+
+    name: str
+    anchors: Tuple[Tuple[str, Optional[str]], ...]
+    primitives: frozenset
+    rationale: str = ""
+
+    def matches_frame(self, file_name: str, function_name: Optional[str]
+                      ) -> bool:
+        norm = (file_name or "").replace("\\", "/")
+        for suffix, fn in self.anchors:
+            if not norm.endswith(suffix):
+                continue
+            if fn is None or fn == function_name:
+                return True
+        return False
+
+
+ISLANDS: Dict[str, Island] = {
+    "instance-norm": Island(
+        name="instance-norm",
+        anchors=(("models/attention.py", "_instance_norm"),),
+        primitives=frozenset({"reduce_sum", "rsqrt"}),
+        rationale="normalization statistics: mean/var reductions and "
+                  "the rsqrt over (var + eps) — bf16 variance of a "
+                  "near-constant grid cancels to noise"),
+    "attention-lse": Island(
+        name="attention-lse",
+        anchors=(("ops/attention.py", "multihead_attention"),
+                 ("ops/attention.py", "multihead_attention_kv_sharded"),
+                 ("ops/pallas_attention.py", None)),
+        primitives=frozenset({"reduce_max", "reduce_sum", "exp", "div"}),
+        rationale="softmax log-sum-exp: the max-subtraction, exp, and "
+                  "normalizing sum must run fp32 or bf16 logits "
+                  "saturate the attention distribution"),
+    "demodulation": Island(
+        name="demodulation",
+        # anchored on the coefficient helper, not modulated_conv2d
+        # itself: the scale-application muls there (and their backward
+        # broadcast-reductions) intentionally ride the compute dtype,
+        # like the conv they wrap — the fp32 contract is the
+        # sum-of-squares/rsqrt coefficient math.
+        anchors=(("ops/modulated_conv.py", "_demod_coeffs"),
+                 ("ops/pallas_modconv.py", None)),
+        primitives=frozenset({"rsqrt", "dot_general", "reduce_sum"}),
+        rationale="demod coefficients: rsqrt of a sum of squares over "
+                  "kh*kw*Cin terms — precision-sensitive at any width, "
+                  "catastrophic at bf16"),
+    "loss-reductions": Island(
+        name="loss-reductions",
+        anchors=(("losses/gan.py", None),),
+        primitives=frozenset({"reduce_sum"}),
+        rationale="loss/penalty means and the R1/PL sums of squares: "
+                  "the scalars the optimizer actually follows"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericContract:
+    """Per-entry fp32 intent: islands that must appear in the traced
+    program AND compute on fp32 operands, plus whether the optimizer
+    moment leaves (g_opt/d_opt float state) must be fp32."""
+
+    islands: Tuple[str, ...]
+    opt_moments: bool = False
+
+
+_TRAIN = NumericContract(
+    islands=("instance-norm", "attention-lse", "demodulation",
+             "loss-reductions"),
+    opt_moments=True)
+# Pure-synthesis programs (no loss, no optimizer): the three model
+# islands only.
+_SYNTH = NumericContract(
+    islands=("instance-norm", "attention-lse", "demodulation"))
+# Mapping-network-only programs: no islands required (anything matched
+# would still be audited, but the mapping MLP has none).
+_MAP = NumericContract(islands=())
+
+# Keyed by short entry name (parallel.contracts.short_entry_name), one
+# entry per ENTRY_CONTRACTS member — entry_points.add() refuses a new
+# entry without a declaration here, same loud guard as the sharding
+# contract.  The quantized-synthesis direction (ROADMAP item 3) changes
+# THIS table and the audit starts asserting the new intent.
+NUMERIC_CONTRACTS: Dict[str, NumericContract] = {
+    "d_step": _TRAIN,
+    "d_step_r1": _TRAIN,
+    "g_step": _TRAIN,
+    "g_step_pl": _TRAIN,
+    "cycle": _TRAIN,
+    "sample": _SYNTH,
+    "ppl_pairs": _SYNTH,
+    "serve_map_seeds": _MAP,
+    "serve_map_z": _MAP,
+    "serve_synth": _SYNTH,
+}
+
+
+def numeric_contract_for(name: str) -> Optional[NumericContract]:
+    """Contract for an entry-point name ("steps.d_step[tiny-f32]" or a
+    bare short name); None = undeclared (fixtures)."""
+    from gansformer_tpu.parallel.contracts import short_entry_name
+
+    return NUMERIC_CONTRACTS.get(short_entry_name(name))

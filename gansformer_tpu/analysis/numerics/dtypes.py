@@ -1,0 +1,42 @@
+"""Dtype numerics facts the graftnum rules key off (ISSUE 19).
+
+Kept jax-free on purpose: the AST half (``eps_dtype.py``) runs in
+pre-commit environments without accelerator libs, and
+``tests/tolerances.py`` imports this table so the test suite's
+tolerance bands and the lint's thresholds cannot drift apart.  The
+values are pinned against ``jnp.finfo`` by
+``tests/test_numerics_rules.py::test_machine_eps_matches_jnp_finfo``.
+
+The central fact: bfloat16 keeps float32's 8-bit exponent (so ``1e-8``
+is *representable*) but only ~8 mantissa bits — ``x + 1e-8 == x`` for
+any ``x`` of normal magnitude, which is why an eps guard below the
+machine epsilon is a silent no-op rather than an overflow.
+"""
+
+from __future__ import annotations
+
+# Machine epsilon (ulp of 1.0): the smallest e with 1.0 + e != 1.0.
+MACHINE_EPS = {
+    "bfloat16": 2.0 ** -7,     # 0.0078125
+    "float16": 2.0 ** -10,     # 0.0009765625
+    "float32": 2.0 ** -23,     # ~1.1920929e-07
+    "float64": 2.0 ** -52,     # ~2.220446e-16
+}
+
+# An additive eps below this floor cannot move a same-dtype operand of
+# normal magnitude — the eps-dtype-mismatch threshold.
+EPS_FLOOR = MACHINE_EPS
+
+# Dtypes whose accumulations/eps-guards the rules treat as hazardous.
+NARROW_FLOAT_DTYPES = ("bfloat16", "float16")
+
+# reduction-accumulation: a narrow-dtype reduce_sum/reduce_max/
+# dot_general folding at least this many elements without an fp32
+# accumulator is a finding.  At 4096 bf16 terms the worst-case relative
+# accumulation error (n * eps/2) reaches ~16 ulps of the result — the
+# scale at which the replication paper's FID drift became visible.
+ACCUM_THRESHOLD = 4096
+
+
+def is_narrow_name(dtype_name: str) -> bool:
+    return dtype_name in NARROW_FLOAT_DTYPES

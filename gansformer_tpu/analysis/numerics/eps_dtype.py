@@ -1,0 +1,176 @@
+"""eps-dtype-mismatch — eps literals below the operand dtype's machine
+epsilon (ISSUE 19, the AST half of graftnum).
+
+bfloat16 keeps float32's exponent range, so ``1e-8`` is perfectly
+representable — and perfectly useless: with ~8 mantissa bits,
+``x + 1e-8 == x`` for any ``x`` of normal magnitude, so an eps guard
+copied from fp32 code silently evaporates and the rsqrt/log it was
+guarding is back to dividing by zero.
+
+The rule is deliberately conservative, because ambient dtypes are the
+jaxpr half's job (``fp32-island-contract`` sees the truth the source
+can't spell): it fires only when the *source* resolves the operand to
+a narrow dtype — a name assigned through ``.astype(jnp.bfloat16)`` /
+``astype('float16')``-style casts — and a positive literal below that
+dtype's machine epsilon is added to it (or ``jnp.maximum``-ed against
+it).  Names resolved to an fp32 island the way ``_instance_norm``
+spells it (``x32 = x.astype(jnp.float32)``) are quiet, as are
+unresolved names.  Thresholds come from ``dtypes.EPS_FLOOR`` — the
+same table ``tests/tolerances.py`` keys its bands off.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from gansformer_tpu.analysis.engine import FileContext, Rule, register
+
+from gansformer_tpu.analysis.numerics.dtypes import (
+    EPS_FLOOR, NARROW_FLOAT_DTYPES)
+
+_WIDE = ("float32", "float64")
+
+
+def _dtype_token(node: ast.AST) -> Optional[str]:
+    """The dtype a cast argument spells: ``jnp.bfloat16``,
+    ``'bfloat16'``, ``np.float32`` … → its name, else None."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+    else:
+        return None
+    return name if name in NARROW_FLOAT_DTYPES + _WIDE else None
+
+
+def _cast_dtype(node: ast.AST) -> Optional[str]:
+    """dtype of an explicit cast call: ``x.astype(D)``,
+    ``jnp.asarray(x, D)`` / ``dtype=D`` kwargs,
+    ``lax.convert_element_type(x, D)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "astype" and node.args:
+        return _dtype_token(node.args[0])
+    if isinstance(fn, ast.Attribute) and \
+            fn.attr in ("asarray", "array", "full", "zeros", "ones",
+                        "convert_element_type"):
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                return _dtype_token(kw.value)
+        if fn.attr == "convert_element_type" and len(node.args) >= 2:
+            return _dtype_token(node.args[1])
+        if fn.attr in ("asarray", "full") and len(node.args) >= 2:
+            return _dtype_token(node.args[1])
+    return None
+
+
+def _class_of(dtype: Optional[str]) -> Optional[str]:
+    if dtype in NARROW_FLOAT_DTYPES:
+        return dtype          # keep the dtype — the threshold needs it
+    if dtype in _WIDE:
+        return "wide"
+    return None
+
+
+def _expr_class(expr: ast.AST, env: Dict[str, str]) -> Optional[str]:
+    """Resolve an expression's dtype class from the source: the
+    expression's own top-level cast wins; otherwise any referenced
+    wide-resolved name makes it wide (islands stay quiet), else the
+    first narrow-resolved name makes it narrow."""
+    top = _cast_dtype(expr)
+    if top is not None:
+        return _class_of(top)
+    classes = [env[n.id] for n in ast.walk(expr)
+               if isinstance(n, ast.Name) and n.id in env]
+    if "wide" in classes:
+        return "wide"
+    for c in classes:
+        if c != "wide":
+            return c
+    return None
+
+
+def _literal_value(node: ast.AST,
+                   lits: Dict[str, float]) -> Optional[float]:
+    if isinstance(node, ast.Constant) and \
+            isinstance(node.value, float):
+        return node.value
+    if isinstance(node, ast.Name) and node.id in lits:
+        return lits[node.id]
+    return None
+
+
+@register
+class EpsDtypeMismatchRule(Rule):
+    id = "eps-dtype-mismatch"
+    description = ("eps literal below the operand dtype's machine "
+                   "epsilon — x + 1e-8 is a no-op guard in bfloat16")
+    hint = ("compute the guarded op in an fp32 island (x32 = "
+            "x.astype(jnp.float32), like _instance_norm) or use an eps "
+            "the dtype can represent (see analysis/numerics/dtypes."
+            "EPS_FLOOR and tests/tolerances.py)")
+    node_types = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def check(self, node: ast.AST, ctx: FileContext) -> None:
+        env: Dict[str, str] = {}
+        lits: Dict[str, float] = {}
+        # float parameter defaults are the classic carrier of a copied
+        # fp32 eps (def f(x, eps=1e-8): …)
+        args = node.args
+        pos = args.posonlyargs + args.args
+        for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults):
+            if isinstance(default, ast.Constant) and \
+                    isinstance(default.value, float):
+                lits[arg.arg] = default.value
+        for kwarg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if isinstance(default, ast.Constant) and \
+                    isinstance(default.value, float):
+                lits[kwarg.arg] = default.value
+        stmts = sorted(
+            (n for n in ast.walk(node)
+             if isinstance(n, (ast.Assign, ast.AnnAssign))),
+            key=lambda n: n.lineno)
+        for stmt in stmts:
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            value = stmt.value
+            if value is None:
+                continue
+            cls = _expr_class(value, env)
+            if cls is None:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    env[t.id] = cls
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.BinOp) and \
+                    isinstance(sub.op, ast.Add):
+                pairs = ((sub.left, sub.right), (sub.right, sub.left))
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("maximum", "minimum") and \
+                    len(sub.args) == 2:
+                pairs = ((sub.args[0], sub.args[1]),
+                         (sub.args[1], sub.args[0]))
+            else:
+                continue
+            for lit_node, operand in pairs:
+                eps = _literal_value(lit_node, lits)
+                if eps is None or not 0.0 < eps:
+                    continue
+                cls = _expr_class(operand, env)
+                if cls is None or cls == "wide":
+                    continue
+                floor = EPS_FLOOR[cls]
+                if eps >= floor:
+                    continue
+                ctx.report(self, sub,
+                           f"eps literal {eps:g} is below {cls}'s "
+                           f"machine epsilon ({floor:g}): the guard is "
+                           f"a no-op in {cls} arithmetic")
+                break

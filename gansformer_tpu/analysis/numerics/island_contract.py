@@ -1,0 +1,143 @@
+"""fp32-island-contract — audit the declared fp32 islands in the
+compiled step programs (ISSUE 19).
+
+The graftcomms ``partition-contract`` shape applied to dtypes: the
+declared side is ``contracts.NUMERIC_CONTRACTS`` (per entry point, the
+islands that MUST compute in fp32); the audit side walks the traced
+jaxpr and checks every island-matched equation's float operand avals.
+Two failure modes, both findings:
+
+* a matched equation computes on bf16/f16 operands — the island cast
+  rotted (or a new code path skipped it);
+* a *required* island matches nothing — the contract anchors rotted
+  (the formulation moved file/function) or the math disappeared, which
+  is exactly how a silently-narrowed accumulator would present.
+
+Backward-pass equations inherit the forward line's source info, so the
+audit covers the gradient half of each island for free.  Per-entry
+audit records land in ``TraceContext.numerics`` — the ``--format
+json`` / selfcheck artifact's proof that e.g. the tiny-bf16 programs
+run instance-norm, demodulation, and the attention lse in fp32.
+
+The optimizer-moment half cannot anchor on frames (optax internals are
+not repo frames): it checks the float leaves under ``g_opt``/``d_opt``
+of the entry's abstract state instead.
+"""
+
+from __future__ import annotations
+
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, TraceRule, iter_eqns, path_str, register)
+
+from gansformer_tpu.analysis.numerics.contracts import (
+    ISLANDS, numeric_contract_for)
+from gansformer_tpu.analysis.numerics.jaxpr_util import (
+    dtype_name, is_float, is_narrow_float, user_frame)
+
+
+@register
+class Fp32IslandContractRule(TraceRule):
+    id = "fp32-island-contract"
+    description = ("declared fp32 island (norm stats, demod rsqrt, "
+                   "attention lse, loss reductions, optimizer moments) "
+                   "computing on narrow-dtype operands, or missing from "
+                   "the traced program")
+    hint = ("restore the island cast (x32 = x.astype(jnp.float32) before "
+            "the reduction/rsqrt) or update analysis/numerics/"
+            "contracts.py if the formulation legitimately moved")
+    dynamic = False
+
+    def __init__(self):
+        # shared model lines are traced via many entries — one finding
+        # per (island, line, dtype) keeps reports and baselines stable
+        # across profiles
+        self._seen = set()
+
+    def check(self, ep: EntryPoint, ctx: TraceContext) -> None:
+        contract = numeric_contract_for(ep.name)
+        if contract is None:
+            ctx.notes.append(f"fp32-island-contract: {ep.name}: no "
+                             f"numeric contract declared — skipped "
+                             f"(fixture entry?)")
+            return
+        closed = ctx.jaxpr(ep)
+        islands = [ISLANDS[n] for n in contract.islands]
+        audit = {isl.name: {"eqns": 0, "violations": 0, "dtypes": set()}
+                 for isl in islands}
+        for eqn in iter_eqns(closed.jaxpr):
+            frame = user_frame(eqn)
+            if frame is None:
+                continue
+            file_name, fn_name, line = frame
+            for isl in islands:
+                if eqn.primitive.name not in isl.primitives:
+                    continue
+                if not isl.matches_frame(file_name, fn_name):
+                    continue
+                rec = audit[isl.name]
+                rec["eqns"] += 1
+                float_in = [v.aval for v in eqn.invars
+                            if is_float(v.aval)]
+                rec["dtypes"] |= {dtype_name(a) for a in float_in}
+                narrow = [a for a in float_in if is_narrow_float(a)]
+                if narrow:
+                    rec["violations"] += 1
+                    key = (isl.name, file_name, line,
+                           dtype_name(narrow[0]))
+                    if key not in self._seen:
+                        self._seen.add(key)
+                        ctx.report(self, (file_name, line),
+                                   f"{isl.name} island: "
+                                   f"{eqn.primitive.name} computes on "
+                                   f"{dtype_name(narrow[0])} operands — "
+                                   f"contract requires float32 "
+                                   f"({isl.rationale}; first traced via "
+                                   f"{ep.name})")
+        for isl in islands:
+            if audit[isl.name]["eqns"] == 0:
+                ctx.report(self, ep.anchor,
+                           f"{ep.name}: required fp32 island "
+                           f"{isl.name!r} matched no equation in the "
+                           f"traced program — the contract anchors "
+                           f"rotted or the formulation moved (declare "
+                           f"the new anchor in analysis/numerics/"
+                           f"contracts.py)")
+        if contract.opt_moments:
+            self._check_opt_moments(ep, ctx, audit)
+        ctx.numerics.append({
+            "entry": ep.name,
+            "compute_dtype": ep.compute_dtype,
+            "islands": {name: {"eqns": rec["eqns"],
+                               "violations": rec["violations"],
+                               "dtypes": sorted(rec["dtypes"]),
+                               "ok": rec["eqns"] > 0
+                               and rec["violations"] == 0}
+                        for name, rec in audit.items()},
+        })
+
+    def _check_opt_moments(self, ep: EntryPoint, ctx: TraceContext,
+                           audit: dict) -> None:
+        import jax
+
+        from gansformer_tpu.parallel.contracts import key_str
+
+        state_abs = ep.abstract_args[0]
+        bad = []
+        dtypes = set()
+        n = 0
+        for path, leaf in jax.tree_util.tree_leaves_with_path(state_abs):
+            head = key_str(path[0]) if path else ""
+            if head not in ("g_opt", "d_opt") or not is_float(leaf):
+                continue
+            n += 1
+            dtypes.add(dtype_name(leaf))
+            if is_narrow_float(leaf):
+                bad.append((path_str(path), dtype_name(leaf)))
+        for leaf_path, dt in bad[:4]:     # a narrowed tree repeats per leaf
+            ctx.report(self, ep.anchor,
+                       f"{ep.name}: optimizer moment {leaf_path} is {dt} "
+                       f"— moment accumulators must stay float32 "
+                       f"(narrow moments forget small gradients)")
+        audit["optimizer-moments"] = {
+            "eqns": n, "violations": len(bad), "dtypes": sorted(dtypes),
+            "ok": n > 0 and not bad}

@@ -1,0 +1,319 @@
+"""Shared jaxpr-walking helpers for the numerics trace rules.
+
+Extends ``analysis/trace/base.py``'s utilities with what dtype-level
+auditing needs: user frames *with the function name* (island anchors
+match on it), a producer map over the whole recursed program, and
+bounded dataflow searches for eps guards and max-domination.  All jax
+imports are lazy — the module must import cleanly in jax-free
+environments (the AST half of graftlint pulls the package in).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from gansformer_tpu.analysis.trace.base import iter_eqns
+
+from gansformer_tpu.analysis.numerics.dtypes import (
+    MACHINE_EPS, NARROW_FLOAT_DTYPES)
+
+# Shape/dtype plumbing that neither accumulates nor rescales: a guard
+# or a max-subtraction survives passing through these.
+TRANSPARENT_PRIMS = frozenset({
+    "convert_element_type", "broadcast_in_dim", "reshape", "squeeze",
+    "expand_dims", "transpose", "slice", "copy", "stop_gradient",
+})
+
+_SEARCH_DEPTH = 16      # bounded best-effort; chains are short in practice
+
+
+def dtype_name(aval) -> str:
+    return str(getattr(getattr(aval, "dtype", None), "name",
+                       getattr(aval, "dtype", "?")))
+
+
+def is_float(aval) -> bool:
+    # name-based first: np.issubdtype(bfloat16, np.floating) is False
+    # (ml_dtypes extension types are not numpy floating subtypes), and
+    # missing bf16 here would make the island audit report false cleans
+    if dtype_name(aval) in MACHINE_EPS:
+        return True
+    try:
+        import numpy as np
+
+        return bool(np.issubdtype(aval.dtype, np.floating))
+    except Exception:
+        return False
+
+
+def is_narrow_float(aval) -> bool:
+    return dtype_name(aval) in NARROW_FLOAT_DTYPES
+
+
+def user_frame(eqn) -> Optional[Tuple[str, Optional[str], int]]:
+    """(file, function name, line) of the user frame that generated the
+    eqn — ``base.eqn_frame`` plus the function name the island anchors
+    match on.  None for library-internal eqns."""
+    try:
+        import jax._src.source_info_util as siu
+
+        frame = siu.user_frame(eqn.source_info)
+        if frame is not None:
+            return (frame.file_name,
+                    getattr(frame, "function_name", None),
+                    frame.start_line)
+    except Exception:
+        pass
+    return None
+
+
+class _BoundaryAlias:
+    """Synthetic pass-through eqn bridging a pjit-style sub-jaxpr
+    boundary: the inner jaxpr's invar 'produces' the matching outer
+    operand through a value-preserving copy, so the dataflow searches
+    keep walking instead of dead-ending at the boundary."""
+
+    class _Prim:
+        name = "copy"
+
+    primitive = _Prim()
+    params: Dict[str, Any] = {}
+    outvars: Tuple[Any, ...] = ()
+
+    def __init__(self, outer_var):
+        self.invars = (outer_var,)
+
+
+def producer_map(jaxpr) -> Dict[Any, Any]:
+    """{outvar: producing eqn} over the program including sub-jaxprs.
+
+    Call-style eqns (pjit, closed_call, custom_*: one inner invar per
+    outer operand, in order) additionally alias each sub-jaxpr invar
+    to its outer operand via a synthetic copy, so chains cross the
+    boundary.  Loop/branch bodies (scan carry offsets, cond operand
+    dropping) are NOT bridged — their invars stay unknown, which only
+    costs precision, never soundness of the quiet direction."""
+    import jax.core as jcore
+
+    out: Dict[Any, Any] = {}
+    for eqn in iter_eqns(jaxpr):
+        for v in eqn.outvars:
+            out[v] = eqn
+        for value in eqn.params.values():
+            for item in (value if isinstance(value, (list, tuple))
+                         else [value]):
+                inner = (item.jaxpr
+                         if isinstance(item, jcore.ClosedJaxpr) else item)
+                if not isinstance(inner, jcore.Jaxpr):
+                    continue
+                if (len(inner.invars) != len(eqn.invars)
+                        or len(inner.outvars) != len(eqn.outvars)):
+                    continue
+                for iv, ov in zip(inner.invars, eqn.invars):
+                    out.setdefault(iv, _BoundaryAlias(ov))
+                # and outward: the call's result IS the body's result,
+                # so searches walk through the call into the body
+                for ov, iv in zip(eqn.outvars, inner.outvars):
+                    out[ov] = _BoundaryAlias(iv)
+    return out
+
+
+def const_map(closed) -> Dict[Any, Any]:
+    """{constvar: concrete value} for the top-level ClosedJaxpr and
+    every nested one (pjit/scan/cond) — a jitted function's closure
+    constants live on the inner pjit jaxpr, not the outer one."""
+    import jax.core as jcore
+
+    out: Dict[Any, Any] = {}
+
+    def add(cj):
+        for var, val in zip(cj.jaxpr.constvars, cj.consts):
+            out[var] = val
+
+    add(closed)
+    for eqn in iter_eqns(closed.jaxpr):
+        for v in eqn.params.values():
+            for item in (v if isinstance(v, (list, tuple)) else [v]):
+                if isinstance(item, jcore.ClosedJaxpr):
+                    add(item)
+    return out
+
+
+_FOLD_MAX_SIZE = 64     # only fold scalars / tiny constant arrays
+
+
+def _const_eval(v, producers: Dict[Any, Any], consts: Dict[Any, Any],
+                depth: int):
+    """Numerically evaluate ``v`` when its producer chain terminates
+    only in literals and closed-over constants (``jnp.var``'s
+    ``n - ddof`` normalizer, precomputed scale factors, …).  Returns a
+    numpy value, or None when any input is runtime data."""
+    import numpy as np
+
+    if depth <= 0:
+        return None
+    if _is_literal(v):
+        return np.asarray(v.val)
+    if v in consts:
+        val = np.asarray(consts[v])
+        return val if val.size <= _FOLD_MAX_SIZE else None
+    eqn = producers.get(v)
+    if eqn is None:
+        return None
+    p = eqn.primitive.name
+    args = None
+    if p in TRANSPARENT_PRIMS or p in ("neg", "sqrt", "rsqrt", "exp",
+                                       "log", "abs", "sign",
+                                       "integer_pow", "add", "sub",
+                                       "mul", "div", "max", "min",
+                                       "pow"):
+        args = [_const_eval(i, producers, consts, depth - 1)
+                for i in eqn.invars]
+        if any(a is None for a in args):
+            return None
+    else:
+        return None
+    try:
+        if p == "convert_element_type":
+            return np.asarray(args[0], dtype=eqn.params["new_dtype"])
+        if p in TRANSPARENT_PRIMS:
+            return args[0]      # value-preserving for positivity checks
+        if p == "integer_pow":
+            return args[0] ** eqn.params["y"]
+        un = {"neg": np.negative, "sqrt": np.sqrt,
+              "rsqrt": lambda x: 1.0 / np.sqrt(x), "exp": np.exp,
+              "log": np.log, "abs": np.abs, "sign": np.sign}
+        if p in un:
+            return un[p](args[0])
+        bi = {"add": np.add, "sub": np.subtract, "mul": np.multiply,
+              "div": np.divide, "max": np.maximum, "min": np.minimum,
+              "pow": np.power}
+        with np.errstate(all="ignore"):
+            return bi[p](args[0], args[1])
+    except Exception:
+        return None
+
+
+def _is_literal(v) -> bool:
+    try:
+        import jax.core as jcore
+
+        return isinstance(v, jcore.Literal)
+    except Exception:
+        return False
+
+
+def _literal_positive(v) -> bool:
+    try:
+        import numpy as np
+
+        return bool(np.all(np.asarray(v.val) > 0))
+    except Exception:
+        return False
+
+
+def has_positive_floor(v, producers: Dict[Any, Any],
+                       depth: int = _SEARCH_DEPTH,
+                       consts: Optional[Dict[Any, Any]] = None) -> bool:
+    """Can we prove ``v`` is bounded away from zero from below?
+
+    The eps-guard question for ``log``/``div``/``rsqrt``: a positive
+    literal reached through adds/maxes is a floor; ``exp`` output is a
+    floor by construction (the softmax-denominator idiom: after max
+    subtraction the max term contributes exp(0) = 1); products, sums,
+    and (r)sqrt of floored values keep the floor; a value computable
+    entirely from closed-over constants (``jnp.var``'s ``n - ddof``
+    normalizer) is folded numerically.  Unknown producers (entry
+    inputs, sub-jaxpr boundaries) prove nothing — the caller treats
+    unprovable as a finding, and the sanctioned-idiom table / inline
+    suppressions absorb formulations the search cannot see.
+    """
+    if depth <= 0:
+        return False
+    if _is_literal(v):
+        return _literal_positive(v)
+    if consts is not None:
+        val = _const_eval(v, producers, consts, depth)
+        if val is not None:
+            import numpy as np
+
+            return bool(np.all(val > 0))
+    eqn = producers.get(v)
+    if eqn is None:
+        return False
+    p = eqn.primitive.name
+    if p in TRANSPARENT_PRIMS:
+        return has_positive_floor(eqn.invars[0], producers, depth - 1,
+                                  consts)
+    if p == "exp":
+        return True
+    if p in ("add", "max"):
+        return any(has_positive_floor(i, producers, depth - 1, consts)
+                   for i in eqn.invars)
+    if p == "mul":
+        return all(has_positive_floor(i, producers, depth - 1, consts)
+                   for i in eqn.invars)
+    if p in ("reduce_sum", "reduce_max", "reduce_prod", "sqrt", "rsqrt"):
+        return has_positive_floor(eqn.invars[0], producers, depth - 1,
+                                  consts)
+    return False
+
+
+def _chain_contains_max(v, producers: Dict[Any, Any], depth: int) -> bool:
+    if depth <= 0 or _is_literal(v):
+        return False
+    eqn = producers.get(v)
+    if eqn is None:
+        return False
+    p = eqn.primitive.name
+    if p in ("reduce_max", "max", "pmax", "argmax"):
+        return True
+    if p in TRANSPARENT_PRIMS:
+        return _chain_contains_max(eqn.invars[0], producers, depth - 1)
+    return False
+
+
+def _chain_contains_abs(v, producers: Dict[Any, Any], depth: int) -> bool:
+    if depth <= 0 or _is_literal(v):
+        return False
+    eqn = producers.get(v)
+    if eqn is None:
+        return False
+    p = eqn.primitive.name
+    if p == "abs":
+        return True
+    if p in TRANSPARENT_PRIMS:
+        return _chain_contains_abs(eqn.invars[0], producers, depth - 1)
+    return False
+
+
+def dominated_by_max(v, producers: Dict[Any, Any],
+                     depth: int = _SEARCH_DEPTH) -> bool:
+    """Is ``exp(v)`` overflow-safe — i.e. is ``v`` bounded above?
+
+    The log-sum-exp question: ``x - max(x)`` (the stable softmax
+    shift, including a ``pmax``/``stop_gradient``-wrapped max), a
+    ``min`` clamp, or ``-|x|`` (the stable softplus/logaddexp interior)
+    all bound the exponent at 0.  Unknown producers prove nothing.
+    """
+    if depth <= 0:
+        return False
+    if _is_literal(v):
+        return True
+    eqn = producers.get(v)
+    if eqn is None:
+        return False
+    p = eqn.primitive.name
+    if p in TRANSPARENT_PRIMS:
+        return dominated_by_max(eqn.invars[0], producers, depth - 1)
+    if p == "sub":
+        return (_chain_contains_max(eqn.invars[1], producers, depth - 1)
+                or dominated_by_max(eqn.invars[0], producers, depth - 1))
+    if p == "add":
+        return any(dominated_by_max(i, producers, depth - 1)
+                   for i in eqn.invars)
+    if p == "neg":
+        return _chain_contains_abs(eqn.invars[0], producers, depth - 1)
+    if p == "min":
+        return True
+    return False

@@ -1,0 +1,92 @@
+"""reduction-accumulation — large narrow-dtype accumulations (ISSUE 19).
+
+A ``reduce_sum``/``reduce_max``/``dot_general`` folding thousands of
+bf16/f16 elements without an fp32 accumulator loses low-order bits on
+every partial sum — wall-clock-invisible, bit-identical across runs,
+and exactly the class of defect that surfaces weeks later as FID
+drift.  The rule flags any such equation accumulating at least
+``dtypes.ACCUM_THRESHOLD`` elements whose *output* is still narrow
+(an f32 output means the upcast already happened —
+``preferred_element_type``/``dtype=`` accumulation) and whose
+producing source line does not itself spell a cast.
+
+Anchoring reuses ``dtype_flow.py``'s discipline: the eqn's user frame
+is the finding line, the ``_EXPLICIT`` regex treats a written-out
+dtype as a decision, and inline ``# graftlint:
+disable=reduction-accumulation`` works on that line.
+"""
+
+from __future__ import annotations
+
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, TraceRule, eqn_frame, in_repo, iter_eqns,
+    line_text, register)
+# the one explicit-cast vocabulary — a line that spells its dtype made a
+# decision, for this rule exactly as for dtype-promotion
+from gansformer_tpu.analysis.trace.dtype_flow import _EXPLICIT
+
+from gansformer_tpu.analysis.numerics.dtypes import ACCUM_THRESHOLD
+from gansformer_tpu.analysis.numerics.jaxpr_util import (
+    dtype_name, is_narrow_float)
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+@register
+class ReductionAccumulationRule(TraceRule):
+    id = "reduction-accumulation"
+    description = (f"reduce_sum/reduce_max/dot_general folding >= "
+                   f"{ACCUM_THRESHOLD} elements at bf16/f16 without an "
+                   f"fp32 accumulator")
+    hint = ("accumulate in fp32: x.astype(jnp.float32) before the "
+            "reduction, jnp.sum(..., dtype=jnp.float32), or "
+            "preferred_element_type=jnp.float32 on the contraction")
+    dynamic = False
+
+    def __init__(self):
+        # one finding per producing line across all entries of a run
+        self._seen = set()
+
+    def check(self, ep: EntryPoint, ctx: TraceContext) -> None:
+        closed = ctx.jaxpr(ep)
+        for eqn in iter_eqns(closed.jaxpr):
+            prim = eqn.primitive.name
+            if prim in ("reduce_sum", "reduce_max"):
+                aval = eqn.invars[0].aval
+                if not is_narrow_float(aval) \
+                        or not is_narrow_float(eqn.outvars[0].aval):
+                    continue
+                axes = eqn.params.get("axes", ())
+                n = _prod(aval.shape[a] for a in axes)
+            elif prim == "dot_general":
+                lhs = eqn.invars[0].aval
+                rhs = eqn.invars[1].aval
+                if not (is_narrow_float(lhs) or is_narrow_float(rhs)) \
+                        or not is_narrow_float(eqn.outvars[0].aval):
+                    continue
+                (lhs_c, _), _ = eqn.params["dimension_numbers"]
+                aval = lhs
+                n = _prod(lhs.shape[d] for d in lhs_c)
+            else:
+                continue
+            if n < ACCUM_THRESHOLD:
+                continue
+            frame = eqn_frame(eqn)
+            if frame is None or not in_repo(frame[0]):
+                continue
+            if _EXPLICIT.search(line_text(*frame)):
+                continue    # the cast/dtype is written — a decision
+            key = (frame[0], frame[1], prim)
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            ctx.report(self, frame,
+                       f"{prim} folds {n} elements at "
+                       f"{dtype_name(aval)} with a "
+                       f"{dtype_name(eqn.outvars[0].aval)} accumulator "
+                       f"(first traced via {ep.name})")

@@ -1,0 +1,137 @@
+"""unstable-primitive — exp/log/div/rsqrt without a provable guard
+(ISSUE 19).
+
+Jaxpr-level stability lint over the compiled step programs:
+
+* ``exp`` whose exponent is not provably bounded above (no
+  max-subtraction / min-clamp / -|x| in its producer chain) can
+  overflow — the log-sum-exp hazard;
+* ``log``/``rsqrt`` whose operand has no provable positive floor
+  (no ``+ eps`` with a positive literal, no ``max(x, c>0)``, no
+  ``exp`` ancestor) can hit 0 → -inf/inf — including in the BACKWARD
+  pass, whose equations inherit the forward line's source info;
+* ``div`` whose divisor is neither a literal nor floored likewise.
+
+The dataflow searches (``jaxpr_util``) are bounded and best-effort:
+*unprovable* counts as a finding, and two escape hatches absorb sound
+formulations the search cannot see — the sanctioned-idiom table below
+(file/function-granular, each entry with a rationale, mirrored in
+docs/static-analysis.md) and the usual inline suppression on the
+anchored line.  Lines that call jax.nn's internally-stabilized
+routines (softmax/softplus/logsumexp/…) are sanctioned wholesale: the
+library formulation IS the stable idiom, and its interior equations
+anchor at the repo call line.
+"""
+
+from __future__ import annotations
+
+import re
+
+from gansformer_tpu.analysis.trace.base import (
+    EntryPoint, TraceContext, TraceRule, in_repo, iter_eqns, line_text,
+    register)
+
+from gansformer_tpu.analysis.numerics.jaxpr_util import (
+    const_map, dominated_by_max, dtype_name, has_positive_floor,
+    is_float, producer_map, user_frame)
+
+# jax.nn / jnp routines that are internally stabilized: equations from
+# their interiors anchor at the repo line that *calls* them, so a line
+# spelling one of these is running the library's stable formulation.
+_STABLE_CALL = re.compile(
+    r"jax\.nn\.(?:softmax|log_softmax|softplus|logsumexp|sigmoid|"
+    r"log_sigmoid|gelu|silu|standardize)|jnp\.logaddexp|nn\.softplus|"
+    r"nn\.softmax")
+
+# (path suffix, function or None) → rationale.  Hand-written stable
+# formulations whose structure the bounded dataflow search cannot
+# prove; each entry is documented in docs/static-analysis.md and the
+# kernel entries are pinned by the Pallas parity tests.
+SANCTIONED_IDIOMS = {
+    ("ops/attention.py", "multihead_attention_kv_sharded"):
+        "streamed lse: exp is dominated by a pmax'd stop_gradient max "
+        "(opaque to the chain search) and the softmax denominator is "
+        ">= exp(0) by construction",
+    ("ops/pallas_attention.py", None):
+        "kernel-side lse: running max/denominator live in fp32 scratch "
+        "refs, which break producer chains; the formulation is the "
+        "textbook online softmax, pinned by the kernel parity tests",
+    ("ops/pallas_modconv.py", None):
+        "kernel-side demod: sigma accumulates in fp32 scratch before "
+        "rsqrt(sigma + eps); the eps add sits across a ref boundary "
+        "the chain search cannot cross",
+}
+
+_CHECKED = ("exp", "log", "div", "rsqrt")
+
+
+def _sanctioned(file_name: str, fn_name) -> bool:
+    norm = (file_name or "").replace("\\", "/")
+    for (suffix, fn), _ in SANCTIONED_IDIOMS.items():
+        if norm.endswith(suffix) and (fn is None or fn == fn_name):
+            return True
+    return False
+
+
+@register
+class UnstablePrimitiveRule(TraceRule):
+    id = "unstable-primitive"
+    description = ("exp not dominated by a max-subtraction, or "
+                   "log/div/rsqrt whose operand lacks a provable "
+                   "positive floor (eps guard)")
+    hint = ("guard the operand (x + eps with a representable eps, "
+            "jnp.maximum(x, eps)) or subtract the max before exp; for "
+            "a formulation that is stable by construction, add it to "
+            "analysis/numerics/unstable_primitive.SANCTIONED_IDIOMS "
+            "with a rationale")
+    dynamic = False
+
+    def __init__(self):
+        self._seen = set()
+
+    def check(self, ep: EntryPoint, ctx: TraceContext) -> None:
+        closed = ctx.jaxpr(ep)
+        producers = producer_map(closed.jaxpr)
+        consts = const_map(closed)
+        for eqn in iter_eqns(closed.jaxpr):
+            prim = eqn.primitive.name
+            if prim not in _CHECKED:
+                continue
+            frame = user_frame(eqn)
+            if frame is None or not in_repo(frame[0]):
+                continue
+            file_name, fn_name, line = frame
+            if _sanctioned(file_name, fn_name):
+                continue
+            if _STABLE_CALL.search(line_text(file_name, line)):
+                continue
+            if prim == "exp":
+                if not is_float(eqn.invars[0].aval):
+                    continue
+                if dominated_by_max(eqn.invars[0], producers):
+                    continue
+                what = ("exp whose exponent is not provably bounded "
+                        "above (no max-subtraction) — overflow hazard")
+            elif prim == "div":
+                divisor = eqn.invars[1]
+                if not is_float(eqn.outvars[0].aval):
+                    continue
+                if has_positive_floor(divisor, producers, consts=consts):
+                    continue
+                what = ("div whose divisor has no provable positive "
+                        "floor — 1/0 hazard")
+            else:       # log / rsqrt
+                operand = eqn.invars[0]
+                if not is_float(eqn.outvars[0].aval):
+                    continue
+                if has_positive_floor(operand, producers, consts=consts):
+                    continue
+                what = (f"{prim} whose operand has no provable "
+                        f"positive floor (eps guard)")
+            key = (file_name, line, prim)
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            ctx.report(self, (file_name, line),
+                       f"{what} at {dtype_name(eqn.invars[0].aval)} "
+                       f"(first traced via {ep.name})")

@@ -247,6 +247,10 @@ def check_metric_families(path: str) -> List[str]:
       the ``data_quarantine.jsonl`` ledger exists beside the prom (a
       counter that moved without its offset+cause evidence is
       unreviewable).
+    * ``train/nonfinite*`` cross-check family (ISSUE 19) — the runtime
+      twin of the graftnum fp32-island audit, materialized by the loop
+      at setup; the cause-labelled counters (loss/grad/param) classify
+      any non-finite tick stat on already-fetched host values.
     """
     from gansformer_tpu.obs.registry import parse_prom_values
 
@@ -290,6 +294,14 @@ def check_metric_families(path: str) -> List[str]:
                           f"{name} (is the ISSUE-17 dispatch seam "
                           f"wired?) — a 0 here is the positive 'no "
                           f"silent XLA fallback' claim")
+    for name in ("train_nonfinite_total", "train_nonfinite_loss_total",
+                 "train_nonfinite_grad_total",
+                 "train_nonfinite_param_total"):
+        if name not in vals:
+            errors.append(f"{path}: missing nonfinite cross-check "
+                          f"counter {name} (is the ISSUE-19 graftnum "
+                          f"runtime twin wired?) — a 0 here is the "
+                          f"positive 'no NaN/inf reached the host' claim")
     if vals.get("data_corrupt_records_total", 0.0) > 0:
         ledger = os.path.join(os.path.dirname(os.path.abspath(path)),
                               "data_quarantine.jsonl")

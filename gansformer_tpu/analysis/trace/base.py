@@ -96,6 +96,7 @@ def register(cls: type) -> type:
 
 def all_trace_rules() -> List[type]:
     """Every registered trace rule class (imports the bundled set)."""
+    from gansformer_tpu.analysis import numerics  # noqa: F401  (registers)
     from gansformer_tpu.analysis.trace import (  # noqa: F401  (registers)
         collective_flow, const_bloat, dtype_flow, partition_contract,
         retrace, sharding_audit)
@@ -122,6 +123,11 @@ class TraceContext:
         self._compiled: Dict[Tuple[str, int], Any] = {}
         self.comms: List[Dict[str, Any]] = []
         self.meshes_compiled: set = set()       # sizes that ACTUALLY built
+        # graftnum surface (ISSUE 19): one fp32-island audit record per
+        # entry with a numeric contract — rides the --format json /
+        # selfcheck payload as the proof that e.g. the tiny-bf16
+        # programs run their declared islands in fp32
+        self.numerics: List[Dict[str, Any]] = []
 
     # -- tracing -------------------------------------------------------------
 

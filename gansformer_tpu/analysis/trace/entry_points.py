@@ -183,6 +183,15 @@ def build_entry_points(config_name: str,
                 f"entry point {short!r}: no sharding contract in "
                 f"parallel/contracts.ENTRY_CONTRACTS — declare the "
                 f"intended PartitionSpecs before adding the entry")
+        from gansformer_tpu.analysis.numerics.contracts import (
+            numeric_contract_for)
+
+        if numeric_contract_for(short) is None:
+            raise ValueError(
+                f"entry point {short!r}: no numeric contract in "
+                f"analysis/numerics/contracts.NUMERIC_CONTRACTS — "
+                f"declare the fp32-island intent before adding the "
+                f"entry (ISSUE 19)")
         path, line = def_site(fn)
         eps.append(EntryPoint(
             name=f"steps.{short}[{config_name}]", fn=fn,
@@ -296,6 +305,13 @@ def build_serve_entry_points(config_name: str = "tiny-f32",
             raise ValueError(
                 f"serve entry point {short!r}: no sharding contract in "
                 f"parallel/contracts.ENTRY_CONTRACTS")
+        from gansformer_tpu.analysis.numerics.contracts import (
+            numeric_contract_for)
+
+        if numeric_contract_for(short) is None:
+            raise ValueError(
+                f"serve entry point {short!r}: no numeric contract in "
+                f"analysis/numerics/contracts.NUMERIC_CONTRACTS")
         path, line = def_site(fn)
         # keep_unused=True: the split programs each use a SUBSET of the
         # params tree (map touches only the mapping network) and XLA

@@ -458,6 +458,27 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
             check("data_plane", "PASS",
                   f"no quarantines, retries, or stalls; {dbits}")
 
+    # -- numerics cross-check (ISSUE 19) ------------------------------------
+    # The runtime twin of graftnum's static fp32-island audit: the loop
+    # classifies any non-finite tick stat by cause (loss/grad/param) on
+    # already-fetched host values.  Graded only when the family is
+    # present (older run dirs skip); nonzero is a WARN, never a FAIL —
+    # the loop kept running, a human decides whether the run is dead.
+    nf_total = _max_counter("train/nonfinite_total")
+    if nf_total is not None:
+        if nf_total > 0:
+            causes = ", ".join(
+                f"{c}={int(_max_counter(f'train/nonfinite_{c}_total') or 0)}"
+                for c in ("loss", "grad", "param"))
+            check("numerics", "WARN",
+                  f"{int(nf_total)} non-finite tick stat(s) reached the "
+                  f"host ({causes}) — cross-check the fp32-island audit "
+                  f"(gansformer-lint --trace) and consider "
+                  f"train.debug_nans for op-level localization")
+        else:
+            check("numerics", "PASS",
+                  "no non-finite tick stats (loss/grad/param all clean)")
+
     # -- compiles / retraces ------------------------------------------------
     compiles = tele.counter("compile/compiles_total")
     retraces = tele.counter("compile/retraces_total")

@@ -131,12 +131,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "loop thread (parity fallback)")
     p.add_argument("--selfcheck", action="store_true",
                    help="run graftlint (AST rules + structural jaxpr "
-                        "trace + the PartitionSpec-contract check on the "
-                        "four train steps) before training; writes "
+                        "trace — including the graftnum fp32-island / "
+                        "accumulation / stability audit — + the "
+                        "PartitionSpec-contract check on the four train "
+                        "steps) before training; writes "
                         "<run_dir>/graftlint.json and aborts on NEW "
-                        "findings — catch a dtype leak or a "
-                        "mis-partitioned step before it burns "
-                        "accelerator hours")
+                        "findings — catch a dtype leak, a bf16 island "
+                        "breach, or a mis-partitioned step before it "
+                        "burns accelerator hours")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans + per-tick finite checks")
     p.add_argument("--profile-dir", default=None,

@@ -105,7 +105,10 @@ def path_length_penalty(
 
     pl_grads = jax.grad(proj)(ws)
     # [N, num_ws, D] → per-sample length: sqrt(mean over ws of sum over D)
-    pl_lengths = jnp.sqrt(
+    # The sqrt backward divides by the path length, which is zero only
+    # when every projected gradient is exactly zero (a dead generator);
+    # the reference formulation is unguarded and we keep its numerics.
+    pl_lengths = jnp.sqrt(  # graftlint: disable=unstable-primitive
         jnp.mean(jnp.sum(jnp.square(pl_grads.astype(jnp.float32)), axis=2), axis=1))
     new_pl_mean = pl_mean + pl_decay * (
         jnp.mean(jax.lax.stop_gradient(pl_lengths)) - pl_mean)

@@ -161,6 +161,17 @@ def conv2d(x: jax.Array, w: jax.Array, up: int = 1, down: int = 1,
     return _conv(x, w, stride=1, padding="SAME")
 
 
+def _demod_coeffs(w32: jax.Array, s32: jax.Array, eps: float) -> jax.Array:
+    """Per-sample demod coefficients 1/||w·s||₂ — the fp32 island the
+    ``demodulation`` numeric contract anchors on (this function's frame,
+    forward AND the backward eqns that inherit it).  Both inputs must
+    already be fp32; keeping the island in its own frame keeps the
+    audit away from the surrounding compute-dtype application math."""
+    sigma = jnp.einsum("hwio,ni->no", jnp.square(w32), jnp.square(s32),
+                       precision=lax.Precision.HIGHEST)
+    return lax.rsqrt(sigma + eps)                       # [N, Cout]
+
+
 def modulated_conv2d(
     x: jax.Array,                 # [N, H, W, Cin]
     w: jax.Array,                 # [kh, kw, Cin, Cout]
@@ -192,8 +203,6 @@ def modulated_conv2d(
     y = conv2d(x, w, up=up, down=down, resample_filter=resample_filter)
 
     if demodulate:
-        sigma = jnp.einsum("hwio,ni->no", jnp.square(w32), jnp.square(s32),
-                           precision=lax.Precision.HIGHEST)
-        d = lax.rsqrt(sigma + eps)                      # [N, Cout]
+        d = _demod_coeffs(w32, s32, eps)                # [N, Cout]
         y = y * d.astype(y.dtype)[:, None, None, :]
     return y

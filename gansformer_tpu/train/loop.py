@@ -15,6 +15,7 @@ immediately continues.
 
 from __future__ import annotations
 
+import math
 import os
 import signal
 import threading
@@ -253,6 +254,15 @@ def _train(cfg: ExperimentConfig, run_dir: str,
     # these at trace time via ops.pallas_upfirdn.note_conv_fallback.
     for c in ("ops/modconv_fallback_total", "ops/modconv_fallback_shape_total",
               "ops/modconv_fallback_vmem_total"):
+        obs.get_registry().counter(c)
+    # Nonfinite cross-check (ISSUE 19): the runtime twin of graftnum's
+    # static fp32-island audit.  Classified at the tick boundary from
+    # values the tick already fetched — no extra device sync — and
+    # materialized here so a 0 in the scrape is a positive "no NaN/inf
+    # reached the host" claim (telemetry_schema requires the family;
+    # the doctor WARNs on any nonzero cause).
+    for c in ("train/nonfinite_total", "train/nonfinite_loss_total",
+              "train/nonfinite_grad_total", "train/nonfinite_param_total"):
         obs.get_registry().counter(c)
     obs.get_registry().gauge("data/corrupt_frac").set(0.0)
     obs.get_registry().gauge("data/corrupt_budget_frac").set(
@@ -680,6 +690,35 @@ def _train(cfg: ExperimentConfig, run_dir: str,
                     # config has no attention-styling gates).
                     gate_stats = wattn_gate_stats(state.g_params)
                 acc_sum, acc_cnt = {}, {}
+                # graftnum runtime cross-check (ISSUE 19): the static
+                # audit proves the islands compute in fp32; this counts
+                # any non-finite value that still reaches the host,
+                # labelled by cause — the lazy-reg penalty metrics
+                # ("/r1", "/pl") ride the gradient path, other fetched
+                # scalars are loss-path, gate stats read parameters.
+                # Only values this tick already fetched: no new sync.
+                nonfinite = {"loss": 0, "grad": 0, "param": 0}
+                for k, v in fetched.items():
+                    if not math.isfinite(v):
+                        cause = ("grad" if k.endswith(("/r1", "/pl"))
+                                 else "loss")
+                        nonfinite[cause] += 1
+                for k, v in (gate_stats or {}).items():
+                    if not math.isfinite(v):
+                        nonfinite["param"] += 1
+                if any(nonfinite.values()):
+                    reg = obs.get_registry()
+                    for cause, n in nonfinite.items():
+                        if n:
+                            reg.counter(
+                                f"train/nonfinite_{cause}_total").inc(n)
+                    reg.counter("train/nonfinite_total").inc(
+                        sum(nonfinite.values()))
+                    log.write(
+                        "WARNING: non-finite tick stats "
+                        f"(kimg {cur_nimg / 1000:.1f}): "
+                        + ", ".join(f"{c}={n}" for c, n
+                                    in nonfinite.items() if n))
                 if t.debug_nans:
                     from gansformer_tpu.utils.debug import check_finite_stats
 

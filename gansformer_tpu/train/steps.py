@@ -253,7 +253,9 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
         grad_fn = jax.value_and_grad(d_loss_fn, has_aux=True)
         (_, aux), grads = grad_fn(state.d_params, state.g_params, reals, z,
                                   rng, label, do_r1)
-        updates, d_opt = d_tx.update(grads, state.d_opt, state.d_params)
+        # Adam bias correction divides by 1 - beta^t, which is positive
+        # because optax increments count before use (t >= 1).
+        updates, d_opt = d_tx.update(grads, state.d_opt, state.d_params)  # graftlint: disable=unstable-primitive
         d_params = optax.apply_updates(state.d_params, updates)
         return pin_state_layout(
             state.replace(d_params=d_params, d_opt=d_opt)), aux
@@ -296,7 +298,9 @@ def make_train_steps(cfg: ExperimentConfig, env: Optional[MeshEnv] = None,
         (_, (aux, new_pl_mean, w_batch_avg)), grads = grad_fn(
             state.g_params, state.d_params, z, rng, state.pl_mean, label,
             do_pl)
-        updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)
+        # Adam bias correction divides by 1 - beta^t, which is positive
+        # because optax increments count before use (t >= 1).
+        updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)  # graftlint: disable=unstable-primitive
         g_params = optax.apply_updates(state.g_params, updates)
         ema_beta = ema_beta_at(state.step)
         ema_params = jax.tree_util.tree_map(

@@ -261,7 +261,15 @@ def test_lint_run_dir_findings_and_cli(tmp_path, capsys):
         "# TYPE ops_modconv_fallback_shape_total counter\n"
         "ops_modconv_fallback_shape_total 0.0\n"
         "# TYPE ops_modconv_fallback_vmem_total counter\n"
-        "ops_modconv_fallback_vmem_total 0.0\n")
+        "ops_modconv_fallback_vmem_total 0.0\n"
+        "# TYPE train_nonfinite_total counter\n"
+        "train_nonfinite_total 0.0\n"
+        "# TYPE train_nonfinite_loss_total counter\n"
+        "train_nonfinite_loss_total 0.0\n"
+        "# TYPE train_nonfinite_grad_total counter\n"
+        "train_nonfinite_grad_total 0.0\n"
+        "# TYPE train_nonfinite_param_total counter\n"
+        "train_nonfinite_param_total 0.0\n")
     assert lint_run_dir(str(tmp_path)) == []
 
     rc = cli_main(["--run-dir", str(tmp_path)])
@@ -287,7 +295,11 @@ def test_check_metric_families_value_aware(tmp_path):
             "data_corrupt_records_total 0.0\ndata_stalls_total 0.0\n"
             "ops_modconv_fallback_total 0.0\n"
             "ops_modconv_fallback_shape_total 0.0\n"
-            "ops_modconv_fallback_vmem_total 0.0\n")
+            "ops_modconv_fallback_vmem_total 0.0\n"
+            "train_nonfinite_total 0.0\n"
+            "train_nonfinite_loss_total 0.0\n"
+            "train_nonfinite_grad_total 0.0\n"
+            "train_nonfinite_param_total 0.0\n")
     base = ("hbm_unavailable 0.0\nhbm_bytes_in_use 1.0\n"
             "hbm_peak_bytes 2.0\ncompile_compiles_total 1.0\n"
             "compile_retraces_total 0.0\n" + data)
@@ -317,7 +329,11 @@ def test_check_metric_families_data_robustness(tmp_path):
             "compile_compiles_total 1.0\ncompile_retraces_total 0.0\n")
     ops = ("ops_modconv_fallback_total 0.0\n"
            "ops_modconv_fallback_shape_total 0.0\n"
-           "ops_modconv_fallback_vmem_total 0.0\n")
+           "ops_modconv_fallback_vmem_total 0.0\n"
+           "train_nonfinite_total 0.0\n"
+           "train_nonfinite_loss_total 0.0\n"
+           "train_nonfinite_grad_total 0.0\n"
+           "train_nonfinite_param_total 0.0\n")
     p = tmp_path / "telemetry.prom"
     # missing family members (the ISSUE-17 conv fallback counters are
     # held to the same explicit-marker discipline)
@@ -326,7 +342,10 @@ def test_check_metric_families_data_robustness(tmp_path):
     for name in ("data_read_retries_total", "data_corrupt_records_total",
                  "data_stalls_total", "ops_modconv_fallback_total",
                  "ops_modconv_fallback_shape_total",
-                 "ops_modconv_fallback_vmem_total"):
+                 "ops_modconv_fallback_vmem_total",
+                 "train_nonfinite_total", "train_nonfinite_loss_total",
+                 "train_nonfinite_grad_total",
+                 "train_nonfinite_param_total"):
         assert any(name in e for e in errs), (name, errs)
     # quarantines moved without the jsonl ledger beside the prom
     p.write_text(head + ops + "data_read_retries_total 0.0\n"

@@ -16,6 +16,7 @@ import numpy as np
 import pytest
 
 from gansformer_tpu.data.dataset import PrefetchIterator
+from tests.tolerances import SCALAR_REPLAY_ABS
 from gansformer_tpu.data.device_prefetch import DevicePrefetcher
 
 _spec = importlib.util.spec_from_file_location(
@@ -259,7 +260,8 @@ def test_overlap_parity_losses_and_checkpoint(micro_run_dir, sync_run_dir):
         keys = [k for k in rs if k.startswith("Loss/")]
         assert keys
         for k in keys:
-            assert ro[k] == pytest.approx(rs[k], abs=1e-6), (k, rs[k], ro[k])
+            assert ro[k] == pytest.approx(
+                rs[k], abs=SCALAR_REPLAY_ABS), (k, rs[k], ro[k])
 
     # checkpoint contents at the last COMMON step, serialized leaves
     def leaves(run_dir, step):

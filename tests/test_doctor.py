@@ -33,7 +33,10 @@ def synth_run_dir(tmp_path, *, gauges=None, counters=None, stats=None,
     c = {"device/samples_total": 2.0, "compile/compiles_total": 12.0,
          "compile/retraces_total": 0.0, "data/starved_total": 0.0,
          "data/corrupt_records_total": 0.0, "data/read_retries_total": 0.0,
-         "data/stalls_total": 0.0}
+         "data/stalls_total": 0.0, "train/nonfinite_total": 0.0,
+         "train/nonfinite_loss_total": 0.0,
+         "train/nonfinite_grad_total": 0.0,
+         "train/nonfinite_param_total": 0.0}
     c.update(counters or {})
     rec = {"Progress/tick": 3, "Progress/kimg": 4.0,
            "timing/sec_per_tick": 10.0, "timing/img_per_sec": 100.0,
@@ -76,8 +79,9 @@ def test_healthy_run_all_pass(tmp_path):
     assert report["ok"] and report["n_fail"] == 0
     lv = levels(report)
     for name in ("artifacts", "progress", "device_truth", "mfu",
-                 "data_wait", "queues", "data_plane", "compiles", "hbm",
-                 "heartbeats", "restarts", "device_phases"):
+                 "data_wait", "queues", "data_plane", "numerics",
+                 "compiles", "hbm", "heartbeats", "restarts",
+                 "device_phases"):
         assert lv[name] == "PASS", (name, lv)
     assert report["n_warn"] == 0
     # device phase table is ranked heaviest-first
@@ -362,6 +366,33 @@ def test_data_plane_warn_on_quarantines_and_retries(tmp_path):
     det = detail(rep, "data_plane")
     assert "2 quarantined" in det and "2 ledger line(s)" in det \
         and "3 read retries" in det
+
+
+def test_numerics_warn_on_nonfinite_with_cause_breakdown(tmp_path):
+    d = synth_run_dir(
+        tmp_path,
+        counters={"train/nonfinite_total": 3.0,
+                  "train/nonfinite_loss_total": 2.0,
+                  "train/nonfinite_grad_total": 1.0})
+    rep = run_doctor(d, now=NOW)
+    assert rep["ok"]                       # WARN never fails the doctor
+    assert levels(rep)["numerics"] == "WARN"
+    det = detail(rep, "numerics")
+    assert "loss=2" in det and "grad=1" in det and "param=0" in det
+    assert "fp32-island" in det
+
+
+def test_numerics_absent_on_pre_issue19_run_dirs(tmp_path):
+    d = synth_run_dir(tmp_path, name="legacy19")
+    import json as _json
+
+    p = os.path.join(d, "stats.jsonl")
+    rec = _json.loads(open(p).read())
+    for k in ("train/nonfinite_total", "train/nonfinite_loss_total",
+              "train/nonfinite_grad_total", "train/nonfinite_param_total"):
+        del rec["telemetry"]["counters"][k]
+    open(p, "w").write(_json.dumps(rec) + "\n")
+    assert "numerics" not in levels(run_doctor(d, now=NOW))
 
 
 def test_data_plane_fail_on_stall_kill(tmp_path):

@@ -1,0 +1,463 @@
+"""Fixture + acceptance tests for graftnum, the numerics half of
+graftlint (ISSUE 19): every rule family has a FIRES case (seeded
+defect), a QUIET case (correct code), and suppression + baseline
+handling over the same fixtures — mirroring tests/test_trace_rules.py
+for the other trace rules and tests/test_analysis_rules.py for the AST
+half.  Plus the contract-resolution units, the machine-epsilon pin
+against jnp.finfo, the whole-repo AST clean gate, and the headline
+acceptance check: the tiny-bf16 step programs really do compute every
+declared fp32 island (and the optimizer moments) in float32.
+
+The jaxpr fixture functions live in THIS file so findings anchor on
+real source lines here (inline ``# graftlint: disable=`` on the
+anchored line suppresses); the eps-dtype fixtures are source STRINGS
+fed to ``lint_source`` so the whole-repo AST gate below doesn't trip
+over its own seeded defects.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gansformer_tpu.analysis.engine import lint_paths, lint_source
+from gansformer_tpu.analysis.numerics.contracts import (
+    ISLANDS, NUMERIC_CONTRACTS, Island, NumericContract,
+    numeric_contract_for)
+from gansformer_tpu.analysis.numerics.dtypes import (
+    ACCUM_THRESHOLD, MACHINE_EPS)
+from gansformer_tpu.analysis.numerics.eps_dtype import EpsDtypeMismatchRule
+from gansformer_tpu.analysis.numerics.island_contract import (
+    Fp32IslandContractRule)
+from gansformer_tpu.analysis.numerics.reduction_accum import (
+    ReductionAccumulationRule)
+from gansformer_tpu.analysis.numerics.unstable_primitive import (
+    UnstablePrimitiveRule)
+from gansformer_tpu.analysis.trace.base import TraceContext, line_text
+from tests.test_trace_rules import BVEC, VEC, ep_for, roundtrip_baseline, \
+    run_one
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# past the accumulation threshold with room to spare
+BVEC8K = jax.ShapeDtypeStruct((2 * ACCUM_THRESHOLD,), jnp.bfloat16)
+
+JAXPR_RULES = (Fp32IslandContractRule, ReductionAccumulationRule,
+               UnstablePrimitiveRule)
+
+
+def _others_quiet(fired_rule, ep):
+    """A seeded defect must fire exactly its own rule."""
+    for cls in JAXPR_RULES:
+        if cls is not fired_rule:
+            assert run_one(cls, ep) == [], cls.id
+
+
+# --- reduction-accumulation -------------------------------------------------
+#
+# jnp.sum on a narrow operand already inserts an f32 accumulator
+# (convert → reduce_sum f32 → convert back) — exactly the fix the rule
+# asks for.  Seeding the defect therefore needs lax.reduce, which
+# lowers to a genuine narrow-in/narrow-out reduce_sum.
+
+_BF0 = jnp.zeros((), jnp.bfloat16)
+
+
+def _accum_narrow(x):
+    return jax.lax.reduce(x, _BF0, jax.lax.add, (0,))
+
+
+def _accum_narrow_suppressed(x):
+    return jax.lax.reduce(x, _BF0, jax.lax.add, (0,))  # graftlint: disable=reduction-accumulation — fixture: suppression contract
+
+
+def _accum_wide_out(x):
+    return jnp.sum(x, dtype=jnp.float32)
+
+
+def _accum_dot(x, y):
+    return jnp.dot(x, y)
+
+
+def test_reduction_accum_fires_on_large_narrow_sum():
+    findings = run_one(ReductionAccumulationRule,
+                       ep_for(_accum_narrow, BVEC8K))
+    assert len(findings) == 1 and findings[0].new
+    assert "reduce_sum" in findings[0].message
+    assert "bfloat16 accumulator" in findings[0].message
+    assert "lax.reduce(x" in line_text(findings[0].path, findings[0].line)
+    _others_quiet(ReductionAccumulationRule, ep_for(_accum_narrow, BVEC8K))
+
+
+def test_reduction_accum_fires_on_narrow_dot_general():
+    findings = run_one(ReductionAccumulationRule,
+                       ep_for(_accum_dot, BVEC8K, BVEC8K))
+    assert len(findings) == 1
+    assert "dot_general" in findings[0].message
+
+
+def test_reduction_accum_quiet_with_explicit_accumulator():
+    assert run_one(ReductionAccumulationRule,
+                   ep_for(_accum_wide_out, BVEC8K)) == []
+
+
+def test_reduction_accum_quiet_below_threshold():
+    # the same formulation over 4 elements is fine — bf16 noise there
+    # is below anything the training signal can see
+    assert run_one(ReductionAccumulationRule,
+                   ep_for(_accum_narrow, BVEC)) == []
+
+
+def test_reduction_accum_suppressed():
+    findings = run_one(ReductionAccumulationRule,
+                       ep_for(_accum_narrow_suppressed, BVEC8K))
+    assert len(findings) == 1
+    assert findings[0].suppressed and not findings[0].new
+
+
+def test_reduction_accum_baselined(tmp_path):
+    roundtrip_baseline(ReductionAccumulationRule,
+                       lambda: ep_for(_accum_narrow, BVEC8K), tmp_path)
+
+
+# --- unstable-primitive -----------------------------------------------------
+
+def _rsqrt_raw(x):
+    return jax.lax.rsqrt(x)
+
+
+def _rsqrt_raw_suppressed(x):
+    return jax.lax.rsqrt(x)  # graftlint: disable=unstable-primitive — fixture: suppression contract
+
+
+def _rsqrt_guarded(x):
+    return jax.lax.rsqrt(jnp.square(x).sum() + 1e-6)
+
+
+def _exp_raw(x):
+    return jnp.exp(x)
+
+
+def _exp_shifted(x):
+    return jnp.exp(x - x.max())
+
+
+def _softmax_library(x):
+    return jax.nn.softmax(x)
+
+
+def _div_raw(x, d):
+    return x / d
+
+
+def _div_guarded(x, d):
+    return x / (jnp.square(d).sum() + 1e-6)
+
+
+def test_unstable_primitive_fires_on_unguarded_rsqrt():
+    ep = ep_for(_rsqrt_raw, VEC)
+    findings = run_one(UnstablePrimitiveRule, ep)
+    assert len(findings) == 1 and findings[0].new
+    assert "rsqrt" in findings[0].message
+    _others_quiet(UnstablePrimitiveRule, ep_for(_rsqrt_raw, VEC))
+
+
+def test_unstable_primitive_fires_on_unshifted_exp():
+    findings = run_one(UnstablePrimitiveRule, ep_for(_exp_raw, VEC))
+    assert len(findings) == 1 and "exp" in findings[0].message
+
+
+def test_unstable_primitive_fires_on_unguarded_div():
+    findings = run_one(UnstablePrimitiveRule, ep_for(_div_raw, VEC, VEC))
+    assert len(findings) == 1 and "div" in findings[0].message
+
+
+def test_unstable_primitive_quiet_on_guarded_forms():
+    assert run_one(UnstablePrimitiveRule, ep_for(_rsqrt_guarded, VEC)) == []
+    assert run_one(UnstablePrimitiveRule, ep_for(_exp_shifted, VEC)) == []
+    assert run_one(UnstablePrimitiveRule,
+                   ep_for(_div_guarded, VEC, VEC)) == []
+    # the library softmax carries its own max-subtraction + exp-floored
+    # denominator — the positivity/domination proofs see through it
+    assert run_one(UnstablePrimitiveRule, ep_for(_softmax_library, VEC)) == []
+
+
+def test_unstable_primitive_suppressed():
+    findings = run_one(UnstablePrimitiveRule,
+                       ep_for(_rsqrt_raw_suppressed, VEC))
+    assert len(findings) == 1
+    assert findings[0].suppressed and not findings[0].new
+
+
+def test_unstable_primitive_baselined(tmp_path):
+    roundtrip_baseline(UnstablePrimitiveRule,
+                       lambda: ep_for(_rsqrt_raw, VEC), tmp_path)
+
+
+# --- fp32-island-contract ---------------------------------------------------
+
+FIXTURE_ISLAND = Island(
+    name="fixture-island",
+    anchors=(("tests/test_numerics_rules.py", None),),
+    primitives=frozenset({"reduce_sum"}),
+    rationale="fixture reduction")
+
+
+def _island_bad(x):
+    xb = x.astype(jnp.bfloat16)
+    return jax.lax.reduce(xb, _BF0, jax.lax.add, (0,))
+
+
+def _island_bad_suppressed(x):
+    xb = x.astype(jnp.bfloat16)
+    return jax.lax.reduce(xb, _BF0, jax.lax.add, (0,))  # graftlint: disable=fp32-island-contract — fixture: suppression contract
+
+
+def _island_good(x):
+    return jnp.sum(x.astype(jnp.float32))
+
+
+def _island_absent(x):
+    return x * 2.0
+
+
+def _moments_fn(state):
+    return state["g_opt"]["mu"].sum() + state["d_opt"]["nu"].sum()
+
+
+def _island_ep(monkeypatch, fn, *args, islands=("fixture-island",),
+               opt_moments=False):
+    """Declare a contract for a fixture entry: NUMERIC_CONTRACTS is
+    keyed by short name, and short_entry_name("fixture._f") == "_f"."""
+    monkeypatch.setitem(ISLANDS, "fixture-island", FIXTURE_ISLAND)
+    monkeypatch.setitem(NUMERIC_CONTRACTS, fn.__name__,
+                        NumericContract(islands=tuple(islands),
+                                        opt_moments=opt_moments))
+    return ep_for(fn, *args)
+
+
+def test_island_contract_fires_on_narrow_island(monkeypatch):
+    findings = run_one(Fp32IslandContractRule,
+                       _island_ep(monkeypatch, _island_bad, VEC))
+    assert len(findings) == 1 and findings[0].new
+    assert "fixture-island island: reduce_sum computes on bfloat16" \
+        in findings[0].message
+    assert "lax.reduce(xb" in line_text(findings[0].path, findings[0].line)
+
+
+def test_island_contract_quiet_and_audited_on_fp32_island(monkeypatch):
+    ep = _island_ep(monkeypatch, _island_good, VEC)
+    ctx = TraceContext()
+    Fp32IslandContractRule().check(ep, ctx)
+    assert ctx.findings == []
+    (rec,) = ctx.numerics
+    isl = rec["islands"]["fixture-island"]
+    assert isl["ok"] and isl["violations"] == 0
+    assert isl["dtypes"] == ["float32"]
+
+
+def test_island_contract_fires_when_required_island_missing(monkeypatch):
+    findings = run_one(Fp32IslandContractRule,
+                       _island_ep(monkeypatch, _island_absent, VEC))
+    assert len(findings) == 1 and findings[0].new
+    assert "matched no equation" in findings[0].message
+
+
+def test_island_contract_notes_undeclared_entries():
+    # no contract (plain fixture): a note, not a finding — the rule
+    # only audits declared intent
+    ctx = TraceContext()
+    Fp32IslandContractRule().check(ep_for(_island_absent, VEC), ctx)
+    assert ctx.findings == [] and ctx.numerics == []
+    assert any("no numeric contract" in n for n in ctx.notes)
+
+
+def test_island_contract_suppressed(monkeypatch):
+    findings = run_one(Fp32IslandContractRule,
+                       _island_ep(monkeypatch, _island_bad_suppressed, VEC))
+    assert len(findings) == 1
+    assert findings[0].suppressed and not findings[0].new
+
+
+def test_island_contract_baselined(monkeypatch, tmp_path):
+    roundtrip_baseline(
+        Fp32IslandContractRule,
+        lambda: _island_ep(monkeypatch, _island_bad, VEC), tmp_path)
+
+
+def test_island_contract_flags_narrow_optimizer_moments(monkeypatch):
+    state = {"g_opt": {"mu": BVEC}, "d_opt": {"nu": VEC}}
+    ep = _island_ep(monkeypatch, _moments_fn, state,
+                    islands=(), opt_moments=True)
+    ctx = TraceContext()
+    Fp32IslandContractRule().check(ep, ctx)
+    assert len(ctx.findings) == 1
+    assert "optimizer moment" in ctx.findings[0].message
+    assert "bfloat16" in ctx.findings[0].message
+    rec = ctx.numerics[0]["islands"]["optimizer-moments"]
+    assert not rec["ok"] and rec["violations"] == 1
+
+
+def test_island_contract_moments_quiet_at_fp32(monkeypatch):
+    state = {"g_opt": {"mu": VEC}, "d_opt": {"nu": VEC}}
+    ep = _island_ep(monkeypatch, _moments_fn, state,
+                    islands=(), opt_moments=True)
+    ctx = TraceContext()
+    Fp32IslandContractRule().check(ep, ctx)
+    assert ctx.findings == []
+    rec = ctx.numerics[0]["islands"]["optimizer-moments"]
+    assert rec["ok"] and rec["dtypes"] == ["float32"]
+
+
+# --- eps-dtype-mismatch (AST half) ------------------------------------------
+
+def _eps_findings(src):
+    return lint_source(src, path="fixture.py",
+                       rules=[EpsDtypeMismatchRule])
+
+
+def test_eps_dtype_fires_on_sub_epsilon_bf16_guard():
+    findings = _eps_findings(
+        "def f(x, eps=1e-8):\n"
+        "    xb = x.astype(jnp.bfloat16)\n"
+        "    return jax.lax.rsqrt(xb + eps)\n")
+    assert len(findings) == 1 and findings[0].new
+    assert "1e-08" in findings[0].message
+    assert "bfloat16" in findings[0].message
+    assert findings[0].line == 3
+
+
+def test_eps_dtype_fires_on_maximum_clamp_and_inline_literal():
+    findings = _eps_findings(
+        "def f(x):\n"
+        "    xb = x.astype('bfloat16')\n"
+        "    return x / jnp.maximum(xb, 1e-9)\n")
+    assert len(findings) == 1 and "1e-09" in findings[0].message
+
+
+def test_eps_dtype_uses_per_dtype_thresholds():
+    # 5e-4 sits below float16's epsilon (2^-10) but above bfloat16's
+    # would-be threshold only if it were wide — the fired class names
+    # the dtype so the fix is obvious
+    findings = _eps_findings(
+        "def f(x, eps=5e-4):\n"
+        "    xh = x.astype(jnp.float16)\n"
+        "    return jnp.log(xh + eps)\n")
+    assert len(findings) == 1 and "float16" in findings[0].message
+
+
+def test_eps_dtype_quiet_on_fp32_island_and_representable_eps():
+    # the _instance_norm idiom: cast to fp32 FIRST, then guard
+    assert _eps_findings(
+        "def f(x, eps=1e-8):\n"
+        "    x32 = x.astype(jnp.float32)\n"
+        "    return jax.lax.rsqrt(x32 + eps)\n") == []
+    # an eps bfloat16 can actually resolve is fine where it is
+    assert _eps_findings(
+        "def f(x, eps=1e-2):\n"
+        "    xb = x.astype(jnp.bfloat16)\n"
+        "    return jax.lax.rsqrt(xb + eps)\n") == []
+    # unresolved operands prove nothing — the jaxpr half owns ambient
+    # dtype truth
+    assert _eps_findings(
+        "def f(x, eps=1e-8):\n"
+        "    return jax.lax.rsqrt(x + eps)\n") == []
+
+
+def test_eps_dtype_suppressed_inline():
+    findings = _eps_findings(
+        "def f(x, eps=1e-8):\n"
+        "    xb = x.astype(jnp.bfloat16)\n"
+        "    return xb + eps  # graftlint: disable=eps-dtype-mismatch — fixture\n")
+    assert len(findings) == 1
+    assert findings[0].suppressed and not findings[0].new
+
+
+def test_machine_eps_matches_jnp_finfo():
+    # dtypes.py promises its jax-free table equals jnp.finfo — pin it
+    for name, eps in MACHINE_EPS.items():
+        assert eps == float(jnp.finfo(name).eps), name
+
+
+# --- contract resolution ----------------------------------------------------
+
+def test_numeric_contracts_cover_entry_catalog():
+    from gansformer_tpu.parallel.contracts import ENTRY_CONTRACTS
+
+    assert set(NUMERIC_CONTRACTS) == set(ENTRY_CONTRACTS)
+
+
+def test_contract_islands_all_declared():
+    for name, contract in NUMERIC_CONTRACTS.items():
+        for isl in contract.islands:
+            assert isl in ISLANDS, (name, isl)
+
+
+def test_numeric_contract_resolution():
+    c = numeric_contract_for("steps.d_step[tiny-f32]")
+    assert c is not None and c.opt_moments
+    assert set(c.islands) == {"instance-norm", "attention-lse",
+                              "demodulation", "loss-reductions"}
+    synth = numeric_contract_for("steps.sample[tiny-bf16]")
+    assert synth is not None and not synth.opt_moments
+    assert "loss-reductions" not in synth.islands
+    assert numeric_contract_for("serve.serve_map_seeds[tiny-f32]").islands \
+        == ()
+    assert numeric_contract_for("fixture._nope") is None
+
+
+def test_entry_points_refuse_undeclared_numeric_contract(monkeypatch):
+    from gansformer_tpu.analysis.trace.entry_points import build_entry_points
+
+    monkeypatch.delitem(NUMERIC_CONTRACTS, "sample")
+    with pytest.raises(ValueError, match="no numeric contract"):
+        build_entry_points("tiny-f32", include=["sample"])
+
+
+# --- whole-repo gates -------------------------------------------------------
+
+def test_eps_dtype_clean_over_repo():
+    """The AST half over everything the pre-commit hook lints, plus
+    tests/ — clean with NO baseline (the repo ships an empty one)."""
+    findings = lint_paths(
+        [os.path.join(ROOT, "gansformer_tpu"),
+         os.path.join(ROOT, "scripts"),
+         os.path.join(ROOT, "tests")],
+        rules=[EpsDtypeMismatchRule])
+    new = [f for f in findings if f.new]
+    assert new == [], "\n".join(
+        f"{f.location}: {f.message}" for f in new)
+
+
+def test_tiny_bf16_islands_compute_fp32():
+    """The headline ISSUE 19 acceptance: in the compiled (traced)
+    tiny-bf16 training programs every declared fp32 island —
+    instance-norm statistics, attention lse, demodulation, the loss
+    reductions — and the optimizer moments compute in float32, with no
+    new numerics findings of any family and an EMPTY baseline."""
+    from gansformer_tpu.analysis.trace.entry_points import build_entry_points
+
+    entries = build_entry_points("tiny-bf16",
+                                 include=["d_step_r1", "g_step_pl"])
+    assert len(entries) == 2
+    ctx = TraceContext()
+    rules = [cls() for cls in JAXPR_RULES]
+    for ep in entries:
+        for rule in rules:
+            rule.check(ep, ctx)
+    new = [f for f in ctx.findings if f.new]
+    assert new == [], "\n".join(
+        f"{f.rule} {f.location}: {f.message}" for f in new)
+    assert len(ctx.numerics) == 2
+    for rec in ctx.numerics:
+        assert rec["compute_dtype"] == "bfloat16"
+        assert set(rec["islands"]) == {
+            "instance-norm", "attention-lse", "demodulation",
+            "loss-reductions", "optimizer-moments"}
+        for name, isl in rec["islands"].items():
+            assert isl["ok"], (rec["entry"], name, isl)
+            assert set(isl["dtypes"]) <= {"float32"}, \
+                (rec["entry"], name, isl)

@@ -27,6 +27,7 @@ from gansformer_tpu.ops.pallas_modconv import (modconv_fits, modconv_plan,
 from gansformer_tpu.ops.pallas_upfirdn import (grad_pad4, upfirdn2d_pallas,
                                                upfirdn_fits, upfirdn_plan)
 from gansformer_tpu.ops.upfirdn2d import setup_filter, upfirdn2d
+from tests.tolerances import FWD, GRAD, TRAIN_REORDER
 from tests.reference_ops import upfirdn2d_ref
 
 # (up, down, pad): even 4-tap and odd 3-tap filters below run each of
@@ -59,13 +60,13 @@ def test_upfirdn_kernel_matches_xla_and_oracle(rng, case, ftaps):
     ref = upfirdn2d(x, f, up=up, down=down, pad=pad)
     got = upfirdn2d_pallas(x, f, up=up, down=down, pad=pad, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               atol=1e-6, rtol=1e-6)
+                               **FWD["float32"])
     from gansformer_tpu.ops.upfirdn2d import _pad4
 
     oracle = upfirdn2d_ref(np.asarray(x, np.float64), np.asarray(f),
                            up=up, down=down, pad=_pad4(pad))
-    np.testing.assert_allclose(np.asarray(got), oracle, atol=1e-5,
-                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got), oracle,
+                               **GRAD["float32"])
 
 
 @pytest.mark.parametrize("case", UFD_CASES[:4],
@@ -85,7 +86,7 @@ def test_upfirdn_kernel_grads_match_xla(rng, case):
     g_got = jax.grad(loss(lambda x_: upfirdn2d_pallas(
         x_, f, up=up, down=down, pad=pad, interpret=True)))(x)
     np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
-                               atol=1e-6, rtol=1e-6)
+                               **FWD["float32"])
 
 
 def test_grad_pad_algebra_inverts_output_shape():
@@ -124,14 +125,14 @@ def test_upfirdn_kernel_fused_epilogue(rng):
                                 act="lrelu", interpret=True)
 
     np.testing.assert_allclose(np.asarray(got(x, b)),
-                               np.asarray(ref(x, b)), atol=1e-6, rtol=1e-6)
+                               np.asarray(ref(x, b)), **FWD["float32"])
     gr = jax.grad(lambda x_, b_: jnp.sum(jnp.sin(ref(x_, b_))),
                   argnums=(0, 1))(x, b)
     gg = jax.grad(lambda x_, b_: jnp.sum(jnp.sin(got(x_, b_))),
                   argnums=(0, 1))(x, b)
     for a, g, name in zip(gr, gg, ("dx", "dbias")):
         np.testing.assert_allclose(np.asarray(g), np.asarray(a),
-                                   atol=1e-5, rtol=1e-5, err_msg=name)
+                                   err_msg=name, **GRAD["float32"])
 
 
 # --------------------------------------------------------------------------
@@ -311,13 +312,13 @@ def test_oversize_and_unsupported_fall_back_to_xla(rng):
     s = jnp.asarray(rng.randn(1, 4) + 1.0, jnp.float32)
     np.testing.assert_allclose(
         np.asarray(modulated_conv2d_pallas(x, w5, s, interpret=True)),
-        np.asarray(modulated_conv2d(x, w5, s)), atol=1e-6, rtol=1e-6)
+        np.asarray(modulated_conv2d(x, w5, s)), **FWD["float32"])
     w3 = jnp.asarray(rng.randn(3, 3, 4, 4) * 0.2, jnp.float32)
     np.testing.assert_allclose(
         np.asarray(modulated_conv2d_pallas(x, w3, s, down=2,
                                            interpret=True)),
-        np.asarray(modulated_conv2d(x, w3, s, down=2)), atol=1e-6,
-        rtol=1e-6)
+        np.asarray(modulated_conv2d(x, w3, s, down=2)),
+        **FWD["float32"])
     assert reg.counter("ops/modconv_fallback_total").value == \
         before[""] + 2
     assert reg.counter("ops/modconv_fallback_shape_total").value == \
@@ -873,6 +874,7 @@ def test_micro_train_row_blocked_no_fallbacks(tmp_path, monkeypatch):
         # First-tick means, same seed: the kernels are near-bit vs the
         # composite, so only chained-update fp reorder separates the
         # backends (the ISSUE 9/14 twin tests' tolerance class).
-        np.testing.assert_allclose(b, a, atol=5e-2, rtol=5e-2,
-                                   err_msg=key)
-        np.testing.assert_allclose(b, a, atol=0.2, rtol=0.2, err_msg=key)
+        np.testing.assert_allclose(b, a, err_msg=key,
+                                   **TRAIN_REORDER["float32"])
+        np.testing.assert_allclose(b, a, err_msg=key,
+                                   **TRAIN_REORDER["bfloat16"])

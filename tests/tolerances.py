@@ -1,0 +1,43 @@
+"""Shared numeric tolerance classes for the parity tests (ISSUE 19).
+
+One home for the constants test_pallas_conv.py and
+test_device_prefetch.py used to repeat inline, keyed by the dtype the
+compared pipelines compute in.  The classes import the machine-epsilon
+table from ``analysis/numerics/dtypes`` so the graftnum lint and the
+tests can never disagree about what a dtype can resolve — the asserts
+at the bottom pin each class sensibly above its dtype's epsilon.
+
+* ``FWD``            — two same-dtype pipelines of the SAME math
+  (Pallas kernel vs XLA composite, both accumulating fp32): near-bit,
+  a few ulps of headroom.
+* ``GRAD``           — one order looser: backward passes chain more
+  rounding steps, and a float64 oracle comparison lands in the same
+  band (the fp32 side carries ~eps_f32 of per-op rounding either way).
+* ``TRAIN_REORDER``  — first-tick loss means across backends, same
+  seed: only chained-update fp reorder separates the runs, but a full
+  tick of D+G updates amplifies it (the ISSUE 9/14 twin-test class).
+* ``SCALAR_REPLAY_ABS`` — host-replayed tick scalars of the SAME
+  program/seed under a different overlap schedule: equal up to the
+  fp32 printing round-trip.
+"""
+
+from gansformer_tpu.analysis.numerics.dtypes import MACHINE_EPS
+
+FWD = {"float32": dict(atol=1e-6, rtol=1e-6)}
+
+GRAD = {"float32": dict(atol=1e-5, rtol=1e-5)}
+
+TRAIN_REORDER = {"float32": dict(atol=5e-2, rtol=5e-2),
+                 "bfloat16": dict(atol=0.2, rtol=0.2)}
+
+SCALAR_REPLAY_ABS = 1e-6
+
+# The classes must sit above the machine epsilon of the dtype they
+# grade — a tolerance below it would be asking for agreement the
+# arithmetic cannot express (exactly the eps-dtype-mismatch rule's
+# complaint about sub-epsilon guards).
+assert FWD["float32"]["atol"] > MACHINE_EPS["float32"]
+assert GRAD["float32"]["atol"] > MACHINE_EPS["float32"]
+assert TRAIN_REORDER["float32"]["atol"] > MACHINE_EPS["float32"]
+assert TRAIN_REORDER["bfloat16"]["atol"] > MACHINE_EPS["bfloat16"]
+assert SCALAR_REPLAY_ABS > MACHINE_EPS["float32"]
