@@ -95,6 +95,19 @@ ISLANDS: Dict[str, Island] = {
         primitives=frozenset({"reduce_sum"}),
         rationale="loss/penalty means and the R1/PL sums of squares: "
                   "the scalars the optimizer actually follows"),
+    "int8w-dequant": Island(
+        name="int8w-dequant",
+        # the q*scale expansion in ops.resolve_weight's helper — the
+        # int8 codes and fp32 per-channel scales are both explicitly
+        # cast to f32 BEFORE the mul, so the dequantized kernel enters
+        # the (possibly bf16) layer math at full precision and the
+        # equalized-lr gain/coef scaling stays bit-matched to the f32
+        # params tree (ISSUE 20, serve_precision='int8w').
+        anchors=(("ops/modulated_conv.py", "_dequant_int8w"),),
+        primitives=frozenset({"mul"}),
+        rationale="weight dequantization q*scale: rounding already cost "
+                  "~0.4% per weight; doing the expansion in bf16 would "
+                  "double the error before the kernel is even used"),
 }
 
 
@@ -119,6 +132,12 @@ _SYNTH = NumericContract(
 # Mapping-network-only programs: no islands required (anything matched
 # would still be audited, but the mapping MLP has none).
 _MAP = NumericContract(islands=())
+# int8w serving (ISSUE 20): the synthesis islands PLUS the dequant
+# expansion — the audit now asserts every QuantizedWeight leaf is
+# expanded to f32 before it meets the compute dtype.
+_SYNTH_INT8W = NumericContract(
+    islands=("instance-norm", "attention-lse", "demodulation",
+             "int8w-dequant"))
 
 # Keyed by short entry name (parallel.contracts.short_entry_name), one
 # entry per ENTRY_CONTRACTS member — entry_points.add() refuses a new
@@ -136,6 +155,11 @@ NUMERIC_CONTRACTS: Dict[str, NumericContract] = {
     "serve_map_seeds": _MAP,
     "serve_map_z": _MAP,
     "serve_synth": _SYNTH,
+    # the serving precision axis (ISSUE 20): bf16 keeps the declared
+    # islands fp32 while activations narrow; int8w adds the dequant
+    # island on top
+    "serve_synth_bf16": _SYNTH,
+    "serve_synth_int8w": _SYNTH_INT8W,
 }
 
 

@@ -330,6 +330,45 @@ def serve_dead_with_work(alive, queue_depth) -> bool:
     return alive == 0.0 and (queue_depth or 0.0) > 0
 
 
+_REPLICA_METRIC = re.compile(r"^serve_replica(\d+)_")
+
+
+def serve_replica_ordinals(vals: dict) -> List[int]:
+    """Replica ordinals present in a parsed prom dict (the
+    ``serve_replica<i>_*`` member families written by replica-mode
+    services, ISSUE 20).  Empty = single-service prom."""
+    return sorted({int(m.group(1)) for name in vals
+                   if (m := _REPLICA_METRIC.match(name))})
+
+
+def serve_fleet_alive(vals: dict) -> bool:
+    """ANY-replica-alive semantics (ISSUE 20): the fleet serves as long
+    as one member's dispatcher runs.  Single-service proms (no replica
+    families) fall back to the global ``serve_dispatcher_alive``
+    gauge — same verdict the pre-fleet healthcheck gave."""
+    ords = serve_replica_ordinals(vals)
+    if not ords:
+        return vals.get("serve_dispatcher_alive", 0.0) > 0
+    return any(vals.get(f"serve_replica{i}_dispatcher_alive", 0.0) > 0
+               for i in ords)
+
+
+def serve_fleet_dead_with_work(vals: dict) -> bool:
+    """Fleet flavor of ``serve_dead_with_work``: hung tickets exist
+    when SOME replica's queue is non-empty while ALL dispatchers are
+    dead — a live member anywhere can still be routed to, so one dead
+    member with queued work is quarantine's problem, not a page."""
+    ords = serve_replica_ordinals(vals)
+    if not ords:
+        return serve_dead_with_work(
+            vals.get("serve_dispatcher_alive", 0.0),
+            vals.get("serve_queue_depth_now", 0.0))
+    any_queued = any(
+        vals.get(f"serve_replica{i}_queue_depth_now", 0.0) > 0
+        for i in ords)
+    return any_queued and not serve_fleet_alive(vals)
+
+
 def check_serve_metric_families(path: str,
                                 expect_overload: bool = False) -> List[str]:
     """Serving SLO families (ISSUE 10 + 13): a service's
@@ -391,6 +430,39 @@ def check_serve_metric_families(path: str,
                       f"{vals.get('serve_queue_bound', 0.0):g}) but "
                       f"serve_shed_total never moved — is admission "
                       f"control wired?")
+    # Replica-fleet families (ISSUE 20) — CONDITIONAL on the prom being
+    # fleet-shaped (serve_replicas present, written by ReplicaSet):
+    # single-service runs keep the exact pre-fleet schema.
+    if "serve_replicas" in vals:
+        for name in ("serve_scale_out_total", "serve_scale_in_total"):
+            if name not in vals:
+                errors.append(f"{path}: fleet prom (serve_replicas "
+                              f"present) missing {name} (is the "
+                              f"autoscaler telemetry wired?)")
+        ords = serve_replica_ordinals(vals)
+        if not ords:
+            errors.append(f"{path}: serve_replicas = "
+                          f"{vals['serve_replicas']:g} but no "
+                          f"serve_replica<i>_* member families — "
+                          f"replica metric redirection rotted")
+        for i in ords:
+            for member in ("health_state", "dispatcher_alive",
+                           "queue_depth_now", "queue_bound",
+                           "requests_total", "images_total",
+                           "batch_ms_count", "batch_fill_count"):
+                name = f"serve_replica{i}_{member}"
+                if name not in vals:
+                    errors.append(f"{path}: replica {i} missing member "
+                                  f"family {name}")
+            # values-aware: a replica that DELIVERED images ran batches,
+            # and every batch observes the member latency histogram —
+            # traffic without samples means attribution rotted
+            if vals.get(f"serve_replica{i}_images_total", 0.0) > 0 and \
+                    vals.get(f"serve_replica{i}_batch_ms_count", 0.0) <= 0:
+                errors.append(
+                    f"{path}: replica {i} delivered images but its "
+                    f"serve_replica{i}_batch_ms histogram has no "
+                    f"samples — per-replica attribution rotted")
     return errors
 
 

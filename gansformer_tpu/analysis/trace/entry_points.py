@@ -254,17 +254,33 @@ def build_serve_entry_points(config_name: str = "tiny-f32",
     ``data`` — not a proxy.  ``bucket`` is the traced batch bucket
     (default: the matrix batch, divisible by every simulated data
     axis)."""
+    import dataclasses
+
     import jax
     import numpy as np
 
     from gansformer_tpu.parallel.contracts import contract_for
     from gansformer_tpu.serve.programs import generator_fns
+    from gansformer_tpu.serve.quant import quantize_params
 
     cfg = trace_configs()[config_name]
     m = cfg.model
     fns = generator_fns(cfg)
+    # the serving precision axis (ISSUE 20): bf16/int8w synthesis runs
+    # the model at bf16 compute — same flip ServePrograms applies
+    bf16_cfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(m, dtype="bfloat16"))
+    bf16_fns = generator_fns(bf16_cfg)
     params_abs = _abstract_state(cfg).ema_params
     states = _StateFactory(cfg)
+
+    def qparams():
+        return quantize_params(states.fresh().ema_params)
+
+    # abstract twin of the quantized tree (QuantizedWeight is a pytree
+    # node, so the map descends into its int8 codes + fp32 scales)
+    qparams_abs = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), qparams())
     seeds_abs = jax.ShapeDtypeStruct((bucket,), np.int32)
     z_abs = jax.ShapeDtypeStruct((bucket, m.num_ws, m.latent_dim),
                                  np.float32)
@@ -272,33 +288,54 @@ def build_serve_entry_points(config_name: str = "tiny-f32",
     w_avg_abs = jax.ShapeDtypeStruct((m.w_dim,), np.float32)
     psi_abs = jax.ShapeDtypeStruct((bucket,), np.float32)
     key_abs = jax.ShapeDtypeStruct((2,), np.uint32)
+    tags_abs = jax.ShapeDtypeStruct((bucket,), np.uint32)
 
     def rand(seed, shape):
         return np.random.RandomState(seed).normal(
             size=shape).astype(np.float32)
+
+    synth_abs = (params_abs, w_avg_abs, ws_abs, psi_abs, key_abs, tags_abs)
+    synth_specs = ("state", "repl", "batch", "batch", "repl", "batch")
+
+    def synth_args(params_fn):
+        return lambda: (params_fn(),
+                        np.zeros(w_avg_abs.shape, np.float32),
+                        rand(21, ws_abs.shape),
+                        np.full((bucket,), 0.7, np.float32),
+                        np.asarray(jax.random.PRNGKey(22)),
+                        np.arange(bucket, dtype=np.uint32))
 
     table = {
         "serve_map_seeds": (
             fns.map_seeds, (params_abs, seeds_abs),
             lambda: (states.fresh().ema_params,
                      np.arange(1, bucket + 1, dtype=np.int32)),
-            ("state", "batch")),
+            ("state", "batch"), m.dtype),
         "serve_map_z": (
             fns.map_z, (params_abs, z_abs),
             lambda: (states.fresh().ema_params, rand(20, z_abs.shape)),
-            ("state", "batch")),
+            ("state", "batch"), m.dtype),
         "serve_synth": (
-            fns.synthesize,
-            (params_abs, w_avg_abs, ws_abs, psi_abs, key_abs),
-            lambda: (states.fresh().ema_params,
-                     np.zeros(w_avg_abs.shape, np.float32),
-                     rand(21, ws_abs.shape),
-                     np.full((bucket,), 0.7, np.float32),
-                     np.asarray(jax.random.PRNGKey(22))),
-            ("state", "repl", "batch", "batch", "repl")),
+            fns.synthesize, synth_abs,
+            synth_args(lambda: states.fresh().ema_params),
+            synth_specs, m.dtype),
+        # the precision variants gate the programs a non-f32 serving
+        # floor actually compiles: bf16 activations over the f32 tree,
+        # and int8w over the QuantizedWeight tree (dequant island
+        # asserted by the fp32-island-contract rule, ISSUE 20)
+        "serve_synth_bf16": (
+            bf16_fns.synthesize, synth_abs,
+            synth_args(lambda: states.fresh().ema_params),
+            synth_specs, "bfloat16"),
+        "serve_synth_int8w": (
+            bf16_fns.synthesize,
+            (qparams_abs,) + synth_abs[1:],
+            synth_args(qparams),
+            synth_specs, "bfloat16"),
     }
     eps: List[EntryPoint] = []
-    for short, (fn, abstract_args, make_args, arg_specs) in table.items():
+    for short, (fn, abstract_args, make_args, arg_specs,
+                compute_dtype) in table.items():
         if include is not None and short not in include:
             continue
         if contract_for(short) is None:   # same loud gate as add()
@@ -323,7 +360,7 @@ def build_serve_entry_points(config_name: str = "tiny-f32",
             fn=jax.jit(fn, keep_unused=True),
             abstract_args=abstract_args, make_args=make_args,
             path=path, line=line, config_name=config_name,
-            compute_dtype=m.dtype, arg_specs=arg_specs))
+            compute_dtype=compute_dtype, arg_specs=arg_specs))
     return eps
 
 

@@ -46,7 +46,8 @@ def healthcheck(path: str, max_age_s=None) -> int:
     forever).  Never imports jax — safe to script from probes on the
     serving host."""
     from gansformer_tpu.analysis.telemetry_schema import (
-        SERVE_HEALTH_NAMES, serve_dead_with_work)
+        SERVE_HEALTH_NAMES, serve_fleet_alive, serve_fleet_dead_with_work,
+        serve_replica_ordinals)
     from gansformer_tpu.obs.registry import parse_prom_values
 
     if os.path.isdir(path):
@@ -63,7 +64,14 @@ def healthcheck(path: str, max_age_s=None) -> int:
                                    "serving telemetry.prom"}))
         return 1
     snapshot_age = time.time() - os.path.getmtime(path)
-    alive = vals.get("serve_dispatcher_alive")
+    # Fleet-aware liveness (ISSUE 20): any-replica-alive — a replica
+    # prom grades on its member families (one dead member with queued
+    # work is quarantine's problem while any dispatcher runs; dead-
+    # with-work means ALL dispatchers dead with SOME queue non-empty).
+    # Single-service proms take the exact pre-fleet global-gauge path.
+    ords = serve_replica_ordinals(vals)
+    alive = serve_fleet_alive(vals)
+    dead_with_work = serve_fleet_dead_with_work(vals)
     depth = vals.get("serve_queue_depth_now", 0.0)
     state = SERVE_HEALTH_NAMES.get(int(code), "unknown")
     stale = max_age_s is not None and snapshot_age > max_age_s
@@ -72,14 +80,22 @@ def healthcheck(path: str, max_age_s=None) -> int:
     out = {"state": state, "prom": path,
            "snapshot_age_s": round(snapshot_age, 1), "ok":
            state in ("ready", "degraded", "closed")
-           and not serve_dead_with_work(alive, depth),
-           "dispatcher_alive": alive, "queue_depth": depth,
+           and not dead_with_work,
+           "dispatcher_alive": 1.0 if alive else 0.0,
+           "queue_depth": depth,
            "queue_bound": vals.get("serve_queue_bound"),
            "dispatcher_restarts":
                vals.get("serve_dispatcher_restarts_total"),
            "shed_total": vals.get("serve_shed_total"),
            "expired_total": vals.get("serve_expired_total"),
            "cancelled_total": vals.get("serve_cancelled_total")}
+    if ords:
+        out["replicas"] = vals.get("serve_replicas")
+        out["replicas_alive"] = sum(
+            1 for i in ords
+            if vals.get(f"serve_replica{i}_dispatcher_alive", 0.0) > 0)
+        out["scale_out_total"] = vals.get("serve_scale_out_total")
+        out["scale_in_total"] = vals.get("serve_scale_in_total")
     print(json.dumps(out, sort_keys=True))
     return 0 if out["ok"] else 1
 
@@ -112,6 +128,21 @@ def main(argv=None) -> int:
                         "compile; the XLA disk cache still applies)")
     p.add_argument("--warm-only", action="store_true",
                    help="populate/validate the manifest and exit")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serving replicas, one per local device "
+                        "(replica-per-chip placement; >1 routes through "
+                        "serve.ReplicaSet)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="autoscaler ceiling (default: local device "
+                        "count)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="scale replicas out on sustained queue "
+                        "saturation, in on batch-fill collapse")
+    p.add_argument("--serve-precision", default="f32",
+                   choices=("f32", "bf16", "int8w"),
+                   help="synthesis precision: f32 reference, bf16 "
+                        "activations, or int8 weight-only quantization "
+                        "(mapping + w-cache always f32)")
     p.add_argument("--wcache", type=int, default=4096,
                    help="w-cache capacity (entries)")
     p.add_argument("--queue-depth", type=int, default=256,
@@ -143,8 +174,8 @@ def main(argv=None) -> int:
     from gansformer_tpu.obs import install_compile_listener
     from gansformer_tpu.obs import registry as telemetry
     from gansformer_tpu.serve import (
-        GenerationService, ServePrograms, default_manifest_dir,
-        init_generator, load_generator)
+        GenerationService, ReplicaSet, ServePrograms,
+        default_manifest_dir, init_generator, load_generator)
     from gansformer_tpu.utils.hostenv import enable_compile_cache
     from gansformer_tpu.utils.image import save_image_grid
     from gansformer_tpu.utils.runarchive import resolve_run_dir
@@ -170,12 +201,33 @@ def main(argv=None) -> int:
 
     manifest_dir = None if args.no_warm_start else (
         args.manifest_dir or default_manifest_dir())
-    programs = ServePrograms(bundle, buckets=buckets,
-                             manifest_dir=manifest_dir)
-    warm = programs.warm_start()
+    # Fleet mode (ISSUE 20): >1 replica or the autoscaler routes through
+    # ReplicaSet (replica-per-device placement + least-loaded routing).
+    # The single-replica default keeps the exact pre-fleet path.
+    fleet = args.replicas > 1 or args.autoscale
+    rs = None
+    if fleet:
+        rs = ReplicaSet(
+            bundle, buckets=buckets, manifest_dir=manifest_dir,
+            serve_precision=args.serve_precision,
+            replicas=args.replicas, max_replicas=args.max_replicas,
+            autoscale=args.autoscale,
+            service_kwargs=dict(
+                wcache_capacity=args.wcache,
+                max_queue_depth=max(args.queue_depth, args.images + 1),
+                default_deadline_s=args.deadline_s))
+        warm = rs.warm_start()
+    else:
+        programs = ServePrograms(bundle, buckets=buckets,
+                                 manifest_dir=manifest_dir,
+                                 serve_precision=args.serve_precision)
+        warm = programs.warm_start()
 
     summary = {
         "buckets": list(buckets),
+        "serve_precision": args.serve_precision,
+        "replicas": rs.n_active if fleet else 1,
+        "autoscale": bool(args.autoscale),
         "restore_ms": round(load_ms, 1),
         "warm_start": {"loaded": warm["loaded"],
                        "compiled": warm["compiled"],
@@ -206,10 +258,10 @@ def main(argv=None) -> int:
         # the demo submits its whole request list unpaced, so the
         # bound must sit above it — shedding the demo's own burst
         # would be admission control arguing with the argument parser
-        svc = GenerationService(programs, wcache_capacity=args.wcache,
-                                max_queue_depth=max(args.queue_depth,
-                                                    args.images + 1),
-                                default_deadline_s=args.deadline_s)
+        svc = rs if fleet else GenerationService(
+            programs, wcache_capacity=args.wcache,
+            max_queue_depth=max(args.queue_depth, args.images + 1),
+            default_deadline_s=args.deadline_s)
         svc.install_signal_drain(grace_s=args.grace_s)
         try:
             t0 = time.perf_counter()
@@ -236,6 +288,10 @@ def main(argv=None) -> int:
         telemetry.get_registry().write_prom(
             os.path.join(out_dir, "telemetry.prom"))
         summary["out"] = out_dir
+    elif rs is not None:
+        # fleet built for warm-only pre-bake (per-ordinal manifests):
+        # drain it cleanly before exiting
+        rs.close(timeout=args.grace_s)
 
     print(json.dumps(summary, sort_keys=True))
     return 0

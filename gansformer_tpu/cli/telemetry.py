@@ -656,6 +656,67 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
                   + (" (closed cleanly)" if serve_health == 3.0
                      else ""))
 
+    # -- serve fleet (ISSUE 20) ---------------------------------------------
+    # Graded only when the replica-per-device layer is visible
+    # (serve/replicas gauge): the fleet families — per-replica health
+    # gauges, scale-out/in counters — must be PRESENT (their absence
+    # means replica attribution rotted while the fleet gauge survived),
+    # and a replica's traffic must come with its latency samples
+    # (images without batch_ms is half-wired attribution).  FAIL only
+    # when the whole fleet is dead with work queued — everything else
+    # is WARN: the fleet serves as long as SOME replica can.
+    n_replicas = tele.gauge("serve/replicas")
+    if n_replicas is not None:
+        from gansformer_tpu.analysis.telemetry_schema import (
+            serve_fleet_dead_with_work, serve_replica_ordinals)
+        from gansformer_tpu.obs.registry import prom_name
+
+        vals = dict(tele._prom)
+        for k, v in list(tele.counters.items()) + list(tele.gauges.items()):
+            vals.setdefault(prom_name(k), v)
+        for k, h in tele.histograms.items():
+            if isinstance(h, dict) and "count" in h:
+                vals.setdefault(prom_name(k) + "_count", h["count"])
+        ordinals = serve_replica_ordinals(vals)
+        outs = vals.get("serve_scale_out_total")
+        ins = vals.get("serve_scale_in_total")
+        alive_n = sum(
+            1 for i in ordinals
+            if vals.get(f"serve_replica{i}_dispatcher_alive", 0.0) > 0)
+        fbits = ("{} active replica(s) (ordinals {}), {} alive, "
+                 "scale-out {} / scale-in {}".format(
+                     int(n_replicas), ordinals or "none", alive_n,
+                     "?" if outs is None else int(outs),
+                     "?" if ins is None else int(ins)))
+        missing = [f"serve_replica{i}_{fam}" for i in ordinals
+                   for fam in ("health_state", "dispatcher_alive",
+                               "queue_depth_now", "requests_total")
+                   if f"serve_replica{i}_{fam}" not in vals]
+        unsampled = [i for i in ordinals
+                     if vals.get(f"serve_replica{i}_images_total", 0.0) > 0
+                     and vals.get(f"serve_replica{i}_batch_ms_count",
+                                  0.0) <= 0]
+        if serve_fleet_dead_with_work(vals):
+            check("serve_fleet", "FAIL",
+                  f"every replica's dispatcher is dead with work still "
+                  f"queued — the fleet hangs its tickets; {fbits}")
+        elif not ordinals:
+            check("serve_fleet", "WARN",
+                  f"serve/replicas present but no serve/replica<i>/* "
+                  f"member families — per-replica attribution rotted; "
+                  f"{fbits}")
+        elif missing or outs is None or ins is None:
+            check("serve_fleet", "WARN",
+                  f"fleet families incomplete — missing "
+                  f"{missing or 'scale counters'}; {fbits}")
+        elif unsampled:
+            check("serve_fleet", "WARN",
+                  f"replica(s) {unsampled} served images with ZERO "
+                  f"batch_ms samples — traffic without latency "
+                  f"attribution; {fbits}")
+        else:
+            check("serve_fleet", "PASS", fbits)
+
     # chaos/loadtest artifacts beside the telemetry, when present
     if chaos_present:
         try:
@@ -695,6 +756,38 @@ def run_doctor(run_dir: str, max_age_s: Optional[float] = None,
                       f"the injected crash never fired; {cbits}")
             else:
                 check("serve_chaos", "PASS", cbits)
+            # the autoscaler drill's ordering evidence (ISSUE 20):
+            # scale-out (the LEADING saturation signal) must beat any
+            # breaker trip (the trailing one), and scale-in must follow
+            # recovery.  A controller that misbehaves under a DRILL is
+            # a WARN, never a FAIL — the floor still served (hung
+            # tickets and health already graded above).
+            asc = chaos.get("autoscale")
+            if isinstance(asc, dict) and asc.get("enabled"):
+                abits = ("scale-out x{} / scale-in x{}, breaker "
+                         "trip(s) {}, peak {} replica(s)".format(
+                             asc.get("scale_out_fired", 0),
+                             asc.get("scale_in_fired", 0),
+                             asc.get("breaker_trips", 0),
+                             asc.get("peak_replicas")))
+                if not asc.get("scale_out_fired"):
+                    check("serve_autoscale", "WARN",
+                          f"controller never scaled out under the "
+                          f"burst — saturation threshold or tick "
+                          f"cadence miscalibrated for the drill; "
+                          f"{abits}")
+                elif not asc.get("scale_out_before_breaker"):
+                    check("serve_autoscale", "WARN",
+                          f"breaker tripped BEFORE the first scale-out "
+                          f"— the controller reacted on the trailing "
+                          f"signal, not the leading one; {abits}")
+                elif not asc.get("scaled_in_after_load"):
+                    check("serve_autoscale", "WARN",
+                          f"no scale-in after recovery — the fleet "
+                          f"stays scaled out (cost leak, not an "
+                          f"outage); {abits}")
+                else:
+                    check("serve_autoscale", "PASS", abits)
 
     # -- SLO error budgets (ISSUE 16) ---------------------------------------
     # Graded only when served traffic is visible (a requests.jsonl

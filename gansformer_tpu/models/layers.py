@@ -18,7 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from gansformer_tpu.ops import conv2d, fused_bias_act, modulated_conv2d
+from gansformer_tpu.ops import (
+    conv2d, fused_bias_act, modulated_conv2d, resolve_weight)
 
 
 def matmul_precision(dtype) -> lax.Precision:
@@ -38,8 +39,11 @@ class EqualDense(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         fan_in = x.shape[-1]
-        w = self.param("w", nn.initializers.normal(stddev=1.0 / self.lrmul),
-                       (fan_in, self.features), jnp.float32)
+        # resolve_weight: int8w serving bundles store QuantizedWeight
+        # leaves; dequant happens here, ahead of the lrmul/gain scaling.
+        w = resolve_weight(
+            self.param("w", nn.initializers.normal(stddev=1.0 / self.lrmul),
+                       (fan_in, self.features), jnp.float32))
         coef = self.gain / math.sqrt(fan_in) * self.lrmul
         y = jnp.dot(x.astype(self.dtype), (w * coef).astype(self.dtype),
                     precision=matmul_precision(self.dtype))
@@ -70,9 +74,10 @@ class EqualConv(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         fan_in = x.shape[-1] * self.kernel**2
-        w = self.param("w", nn.initializers.normal(stddev=1.0 / self.lrmul),
+        w = resolve_weight(
+            self.param("w", nn.initializers.normal(stddev=1.0 / self.lrmul),
                        (self.kernel, self.kernel, x.shape[-1], self.features),
-                       jnp.float32)
+                       jnp.float32))
         coef = self.gain / math.sqrt(fan_in) * self.lrmul
         y = conv2d(x.astype(self.dtype), (w * coef).astype(self.dtype),
                    up=self.up, down=self.down,
@@ -111,9 +116,10 @@ class ModulatedConv(nn.Module):
         # Style affine "A": bias-init 1 so styles start at identity.
         styles = EqualDense(cin, bias_init=1.0, dtype=jnp.float32,
                             name="affine")(w_style)
-        weight = self.param("w", nn.initializers.normal(stddev=1.0),
-                            (self.kernel, self.kernel, cin, self.features),
-                            jnp.float32)
+        weight = resolve_weight(
+            self.param("w", nn.initializers.normal(stddev=1.0),
+                       (self.kernel, self.kernel, cin, self.features),
+                       jnp.float32))
         coef = 1.0 / math.sqrt(cin * self.kernel**2)
         assert noise_mode in ("random", "none"), f"bad noise_mode {noise_mode!r}"
         add_noise = self.use_noise and noise_mode != "none"

@@ -6,7 +6,12 @@ from gansformer_tpu.ops.upfirdn2d import (
     filter_2d,
 )
 from gansformer_tpu.ops.fused_bias_act import fused_bias_act, ACTIVATIONS
-from gansformer_tpu.ops.modulated_conv import modulated_conv2d, conv2d
+from gansformer_tpu.ops.modulated_conv import (
+    QuantizedWeight,
+    conv2d,
+    modulated_conv2d,
+    resolve_weight,
+)
 from gansformer_tpu.ops.attention import (
     multihead_attention,
     multihead_attention_kv_sharded,

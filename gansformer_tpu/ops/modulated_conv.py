@@ -30,6 +30,70 @@ from jax import lax
 from gansformer_tpu.ops.upfirdn2d import filter_2d, upsample_2d, setup_filter, upfirdn2d
 
 
+@jax.tree_util.register_pytree_node_class
+class QuantizedWeight:
+    """int8 weight-only quantized kernel leaf (serve_precision='int8w').
+
+    ``q`` keeps the ORIGINAL kernel shape in int8; ``scale`` is the
+    per-output-channel fp32 scale over the LAST axis (keepdims, so it
+    broadcasts for both the [fan_in, Cout] dense and [kh, kw, Cin, Cout]
+    conv layouts).  Registered as a pytree node so a quantized params
+    tree flows through flax ``apply`` / jit / device_put unchanged; the
+    equalized-LR layers call ``resolve_weight`` on every fetched kernel,
+    which is where dequantization fuses into the weight-prep that feeds
+    both the XLA composites and the Pallas kernels.  ``q`` flattens
+    first: flax validates only the leading leaf's shape against the
+    initializer, and ``q`` keeps the original shape.
+    """
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+    def __repr__(self):
+        return (f"QuantizedWeight(q={self.q.shape}:{self.q.dtype}, "
+                f"scale={self.scale.shape})")
+
+
+def _dequant_int8w(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """int8w dequantization — the fp32 island the ``int8w-dequant``
+    numeric contract anchors on (this function's frame).  The scale
+    application must run fp32: int8 codes span ±127 and a bf16 product
+    would re-quantize the mantissa a second time."""
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)
+
+
+def resolve_weight(w) -> jax.Array:
+    """The kernel-prep seam shared by every equalized-LR layer: plain
+    fp32 kernels pass through; ``QuantizedWeight`` leaves dequantize
+    here, AHEAD of the lrmul/gain scaling and the dtype cast — so the
+    XLA composites and the Pallas modconv kernels both consume the same
+    dequantized weights with no per-backend code."""
+    if isinstance(w, QuantizedWeight):
+        return _dequant_int8w(w.q, w.scale)
+    return w
+
+
 def _conv(x: jax.Array, w: jax.Array, stride: int = 1,
           padding: str = "SAME") -> jax.Array:
     # fp32 inputs get true-fp32 accumulation (XLA's DEFAULT precision may

@@ -153,13 +153,26 @@ ENTRY_CONTRACTS: Dict[str, Contract] = {
     # The serving split (ISSUE 10, serve/programs.py): params always
     # replicated (weight-agnostic executables), per-request rows on
     # ``data``.  serve_map_seeds(params, seeds[B]) / serve_map_z(params,
-    # z) → ws[B,…]; serve_synth(params, w_avg, ws, psi[B], rng) → imgs.
+    # z) → ws[B,…]; serve_synth(params, w_avg, ws, psi[B], rng,
+    # tags[B]) → imgs — tags are the per-row noise identities (ISSUE
+    # 20), request data like psi.  The precision variants (ISSUE 20:
+    # serve_precision=bf16|int8w) share the exact signature — int8w
+    # swaps the params TREE (QuantizedWeight leaves) but not the
+    # argument roles, so one contract shape covers all three and the
+    # partition-contract/collective-flow audits gate each compiled
+    # variant separately.
     "serve_map_seeds": Contract(args=("params", "batch"),
                                 outs=("batch",)),
     "serve_map_z": Contract(args=("params", "batch"), outs=("batch",)),
     "serve_synth": Contract(args=("params", "stat", "batch", "batch",
-                                  "rng"),
+                                  "rng", "batch"),
                             outs=("batch",)),
+    "serve_synth_bf16": Contract(args=("params", "stat", "batch", "batch",
+                                       "rng", "batch"),
+                                 outs=("batch",)),
+    "serve_synth_int8w": Contract(args=("params", "stat", "batch", "batch",
+                                        "rng", "batch"),
+                                  outs=("batch",)),
 }
 
 

@@ -17,6 +17,12 @@ Four layers, bottom-up:
   supervised dispatcher restart with a circuit breaker
   (``ServiceUnhealthy``), bucket quarantine, ``health()`` states, and
   graceful drain (``ServiceClosed``).
+* ``quant``     — the ``serve_precision`` axis (f32 | bf16 | int8w):
+  int8 weight-only quantization with per-output-channel scales, plus
+  the cost/fidelity A/B reports (ISSUE 20).
+* ``replicas``  — replica-per-device placement (``ReplicaSet``):
+  least-loaded routing across device-pinned members, fleet health,
+  and the optional autoscaler controller (ISSUE 20).
 
 ``cli/serve.py`` (``gansformer-serve``) and
 ``scripts/loadtest_serve.py`` sit on top; ``docs/serving.md`` is the
@@ -25,8 +31,11 @@ operator guide.
 
 from gansformer_tpu.serve.cache import WCache, wcache_key  # noqa: F401
 from gansformer_tpu.serve.programs import (  # noqa: F401
-    DEFAULT_BUCKETS, GeneratorBundle, ServePrograms, bucket_for,
-    generator_fns, init_generator, load_generator)
+    DEFAULT_BUCKETS, SERVE_PRECISIONS, GeneratorBundle, ServePrograms,
+    bucket_for, generator_fns, init_generator, load_generator)
+from gansformer_tpu.serve.quant import (  # noqa: F401
+    FIDELITY_TOLERANCES, cost_report, fidelity_report, quantize_params)
+from gansformer_tpu.serve.replicas import Replica, ReplicaSet  # noqa: F401
 from gansformer_tpu.serve.service import (  # noqa: F401
     Cancelled, Expired, GenerationService, Overloaded, ServeError,
     ServiceClosed, ServiceUnhealthy, Ticket)

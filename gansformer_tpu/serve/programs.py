@@ -14,11 +14,14 @@ boundary (the compiler-first cached-intermediate shape of arxiv
   leaves the real rows bit-identical (held by tests/test_serve.py).
 * ``map_z``      — ``(params, z) → ws``: explicit-latent flavor for
   interpolation / parity with the training sampler.
-* ``synthesize`` — ``(params, w_avg, ws, psi[B], rng) → imgs``:
+* ``synthesize`` — ``(params, w_avg, ws, psi[B], rng, tags[B]) → imgs``:
   truncation + synthesis.  ψ rides as a TRACED per-row vector, so ONE
   executable covers every ψ (and mixed-ψ batches); keeping truncation
   here — not in the map programs — makes the w-cache ψ-independent:
-  one cached mapping serves every truncation setting.
+  one cached mapping serves every truncation setting.  ``tags`` are
+  per-row noise identities (the service passes each request's seed) so
+  a row's noise never depends on batch composition, dispatch order, or
+  which replica served it (ISSUE 20).
 
 ``ServePrograms`` AOT-lowers each (kind, batch-bucket) pair to a
 ``Compiled`` executable, warm-starting from the serialized-executable
@@ -48,6 +51,16 @@ from gansformer_tpu.core.config import ExperimentConfig
 from gansformer_tpu.obs import registry as telemetry
 
 DEFAULT_BUCKETS = (1, 2, 4, 8)
+
+# The serving precision axis (ISSUE 20) — synth-program only:
+#   f32   — reference: model dtype as trained (the fidelity anchor)
+#   bf16  — bfloat16 activations, f32 weights (the declared fp32
+#           islands — instance-norm, attention-lse, demodulation —
+#           stay f32 inside the bf16 program)
+#   int8w — bf16 activations + int8 weight-only kernels with
+#           per-output-channel scales (serve/quant.py), dequantized in
+#           the shared kernel-prep seam (ops.resolve_weight)
+SERVE_PRECISIONS = ("f32", "bf16", "int8w")
 
 # Serving programs a warm start pre-builds by default.  ``map_z`` is the
 # explicit-latent flavor only the generate CLI's interpolation path
@@ -107,7 +120,7 @@ def generator_fns(cfg: ExperimentConfig) -> SimpleNamespace:
     def serve_map_z(params, z, label=None):
         return G.apply({"params": params}, z, label, method=Generator.map)
 
-    def serve_synth(params, w_avg, ws, psi, rng):
+    def serve_synth(params, w_avg, ws, psi, rng, tags):
         # per-row traced ψ: ws' = w̄ + ψ·(ws − w̄) — the truncation
         # trick with the EMA anchor, applied HERE (not at mapping time)
         # so cached w rows stay valid for every ψ
@@ -120,13 +133,21 @@ def generator_fns(cfg: ExperimentConfig) -> SimpleNamespace:
         # would break the bucketed-padding parity contract — a padded
         # batch must produce bit-identical prefix rows
         # (tests/test_serve.py).  vmap keeps the batched lowering.
+        #
+        # ``tags`` [B]uint32 are per-row noise identities folded into
+        # ``rng``.  The service passes each request's seed, so a row's
+        # noise is a pure function of the request — never of which
+        # batch, dispatcher, or replica happened to serve it (the
+        # 1-vs-N replica determinism contract, ISSUE 20).  Direct
+        # callers default to arange(B), which is row-position-only and
+        # keeps the padding-parity contract on its own.
         def one(ws_row, key):
             return G.apply({"params": params}, ws_row[None],
                            rngs={"noise": key},
                            method=Generator.synthesize)[0]
 
         keys = jax.vmap(jax.random.fold_in, (None, 0))(
-            rng, jnp.arange(ws.shape[0], dtype=jnp.uint32))
+            rng, tags.astype(jnp.uint32))
         return jax.vmap(one, (0, 0))(ws, keys)
 
     serve_map_seeds.__name__ = "serve_map_seeds"
@@ -148,12 +169,52 @@ class ServePrograms:
     def __init__(self, bundle: GeneratorBundle,
                  buckets: Iterable[int] = DEFAULT_BUCKETS,
                  manifest_dir: Optional[str] = None,
-                 warm_start: bool = True):
+                 warm_start: bool = True,
+                 serve_precision: str = "f32",
+                 device: Optional[Any] = None):
+        if serve_precision not in SERVE_PRECISIONS:
+            raise ValueError(f"serve_precision must be one of "
+                             f"{SERVE_PRECISIONS}, got {serve_precision!r}")
         self.bundle = bundle
         self.buckets = sorted_buckets(buckets)
         self.manifest_dir = manifest_dir
         self.warm_start_enabled = warm_start and manifest_dir is not None
+        self.serve_precision = serve_precision
+        # Replica-per-device placement (ISSUE 20): ``device`` pins THIS
+        # instance's params and executables to one device; the manifest
+        # fingerprint carries the ordinal so replica i's serialized
+        # executables never warm-start replica j.
+        self.device = device
+        self.device_ordinal = int(device.id) if device is not None else 0
         self._fns = generator_fns(bundle.cfg)
+        self._synth_fn = self._fns.synthesize
+        self._map_params = bundle.ema_params
+        self._synth_params = bundle.ema_params
+        self._w_avg = bundle.w_avg
+        if serve_precision != "f32":
+            # The precision axis applies to the SYNTH split program
+            # only: the mapping half stays f32 on the original tree so
+            # one w-cache entry (and one map manifest) serves every
+            # precision — truncation happens inside synth, so cached w
+            # rows are precision-agnostic by construction.
+            import dataclasses as _dc
+            synth_cfg = _dc.replace(
+                bundle.cfg, model=_dc.replace(bundle.cfg.model,
+                                              dtype="bfloat16"))
+            self._synth_fn = generator_fns(synth_cfg).synthesize
+            if serve_precision == "int8w":
+                from gansformer_tpu.serve.quant import quantize_params
+
+                self._synth_params = quantize_params(bundle.ema_params)
+        if device is not None:
+            import jax
+
+            put = lambda t: jax.device_put(t, device)  # noqa: E731
+            self._map_params = put(self._map_params)
+            self._synth_params = (self._map_params
+                                  if self._synth_params is bundle.ema_params
+                                  else put(self._synth_params))
+            self._w_avg = put(self._w_avg)
         self._compiled: Dict[Tuple[str, int], Any] = {}
         # THIS instance's manifest traffic (the global counters span
         # every service a process ever ran — health() needs its own)
@@ -168,31 +229,43 @@ class ServePrograms:
 
     # -- shapes --------------------------------------------------------------
 
+    def _abs(self, shape, dtype) -> Any:
+        """ShapeDtypeStruct, pinned to this replica's device when one
+        is set — the AOT compile then bakes the placement in, so
+        dispatch never pays a cross-device transfer."""
+        import jax
+
+        if self.device is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        from jax.sharding import SingleDeviceSharding
+
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=SingleDeviceSharding(self.device))
+
     def _abstract_args(self, kind: str, bucket: int) -> Tuple[Any, ...]:
         import jax
 
         m = self.bundle.cfg.model
+        params = (self._synth_params if kind == "synthesize"
+                  else self._map_params)
         params_abs = jax.tree_util.tree_map(
-            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
-            self.bundle.ema_params)
-        label_abs = (
-            (jax.ShapeDtypeStruct((bucket, m.label_dim), np.float32),)
-            if m.label_dim else ())
+            lambda l: self._abs(l.shape, l.dtype), params)
+        label_abs = ((self._abs((bucket, m.label_dim), np.float32),)
+                     if m.label_dim else ())
         if kind == "map_seeds":
             return (params_abs,
-                    jax.ShapeDtypeStruct((bucket,), np.int32)) + label_abs
+                    self._abs((bucket,), np.int32)) + label_abs
         if kind == "map_z":
             return (params_abs,
-                    jax.ShapeDtypeStruct(
-                        (bucket, m.num_ws, m.latent_dim),
-                        np.float32)) + label_abs
+                    self._abs((bucket, m.num_ws, m.latent_dim),
+                              np.float32)) + label_abs
         if kind == "synthesize":
             return (params_abs,
-                    jax.ShapeDtypeStruct((m.w_dim,), np.float32),
-                    jax.ShapeDtypeStruct((bucket, m.num_ws, m.w_dim),
-                                         np.float32),
-                    jax.ShapeDtypeStruct((bucket,), np.float32),
-                    jax.ShapeDtypeStruct((2,), np.uint32))
+                    self._abs((m.w_dim,), np.float32),
+                    self._abs((bucket, m.num_ws, m.w_dim), np.float32),
+                    self._abs((bucket,), np.float32),
+                    self._abs((2,), np.uint32),
+                    self._abs((bucket,), np.uint32))
         raise KeyError(f"unknown serve program kind {kind!r}")
 
     # -- compile / warm start ------------------------------------------------
@@ -211,8 +284,19 @@ class ServePrograms:
         ck = (kind, bucket)
         if ck in self._compiled:
             return self._compiled[ck]
+        # The precision axis is synth-only (map always runs f32 on the
+        # original tree), so map manifest entries stay shared across
+        # precisions; the ordinal suffix keeps replica manifests
+        # side-by-side in one dir.  Defaults keep the PR-13 key names.
+        prec = self.serve_precision if kind == "synthesize" else "f32"
         key = f"{kind}_b{bucket}"
-        fp = warmstart.fingerprint(self._model_json, kind, bucket)
+        if prec != "f32":
+            key += f"_{prec}"
+        if self.device_ordinal:
+            key += f"_d{self.device_ordinal}"
+        fp = warmstart.fingerprint(self._model_json, kind, bucket,
+                                   serve_precision=prec,
+                                   device_ordinal=self.device_ordinal)
         if self.warm_start_enabled:
             stale0 = telemetry.counter("serve/manifest_stale_total").value
             compiled = warmstart.load_executable(self.manifest_dir, key, fp)
@@ -222,7 +306,8 @@ class ServePrograms:
                 self.warm_hits += 1
                 self._compiled[ck] = compiled
                 return compiled
-        fn = getattr(self._fns, kind)
+        fn = (self._synth_fn if kind == "synthesize"
+              else getattr(self._fns, kind))
         t0 = time.perf_counter()
         compiled = self._compile(jax.jit(fn), kind, bucket)
         telemetry.counter("serve/compiles_total").inc()
@@ -313,7 +398,7 @@ class ServePrograms:
                              f"to {bucket} first")
         telemetry.counter("serve/map_dispatch_total").inc()
         return self._get("map_seeds", bucket)(
-            self.bundle.ema_params, seeds,
+            self._map_params, seeds,
             *self._label_args(bucket, label))
 
     def map_z(self, z: np.ndarray, label=None):
@@ -325,11 +410,12 @@ class ServePrograms:
                              f"to {bucket} first")
         telemetry.counter("serve/map_dispatch_total").inc()
         return self._get("map_z", bucket)(
-            self.bundle.ema_params, z, *self._label_args(bucket, label))
+            self._map_params, z, *self._label_args(bucket, label))
 
-    def synthesize(self, ws, psi, rng):
-        """ws [bucket, num_ws, w_dim], psi [bucket]f32, rng (2,)uint32 →
-        imgs [bucket, R, R, C] (device, unfetched)."""
+    def synthesize(self, ws, psi, rng, tags=None):
+        """ws [bucket, num_ws, w_dim], psi [bucket]f32, rng (2,)uint32,
+        tags [bucket]uint32 (per-row noise identities; default: row
+        positions) → imgs [bucket, R, R, C] (device, unfetched)."""
         ws = np.ascontiguousarray(ws, np.float32) \
             if isinstance(ws, np.ndarray) else ws
         psi = np.ascontiguousarray(psi, np.float32)
@@ -339,9 +425,14 @@ class ServePrograms:
                              f"({self.buckets}); pad "
                              f"{psi.shape[0]}/{ws.shape[0]} rows to "
                              f"{bucket} first")
+        if tags is None:
+            tags = np.arange(bucket, dtype=np.uint32)
+        tags = np.ascontiguousarray(tags, np.uint32)
+        if tags.shape != (bucket,):
+            raise ValueError(f"tags shape {tags.shape} != ({bucket},)")
         telemetry.counter("serve/synth_dispatch_total").inc()
         return self._get("synthesize", bucket)(
-            self.bundle.ema_params, self.bundle.w_avg, ws, psi, rng)
+            self._synth_params, self._w_avg, ws, psi, rng, tags)
 
 
 # -- checkpoint surface ------------------------------------------------------

@@ -1,0 +1,227 @@
+"""int8 weight-only quantization for the serving synth program
+(``serve_precision='int8w'``, ISSUE 20).
+
+Scheme — the weight-only recipe the serving-throughput literature
+converged on (weights are the bandwidth, activations are the accuracy):
+
+* Every equalized-LR kernel (the ``"w"`` params of EqualDense /
+  EqualConv / ModulatedConv — ndim 2 or 4) is stored as int8 codes plus
+  a **per-output-channel** fp32 scale over the LAST axis:
+  ``scale_c = max|w[..., c]| / 127``, ``q = round(w / scale)``.
+  Per-channel (not per-tensor) because the equalized-LR parametrization
+  keeps channels at unit variance only in expectation — individual
+  output channels drift an order of magnitude apart during training,
+  and a per-tensor scale would burn most of the 8-bit range on the
+  loudest channel.
+* Everything else (biases, ``noise_strength``, the attention tables
+  ``pos_emb``/``d_queries``, the learned ``const`` input, gates) stays
+  fp32: these are O(channels) not O(channels²) — quantizing them saves
+  nothing and costs fidelity.
+* Dequantization happens in ``ops.resolve_weight`` — the kernel-prep
+  seam every equalized-LR layer already routes through — as an fp32
+  island (``int8w-dequant`` in ``analysis/numerics/contracts.py``), so
+  the XLA composites and the Pallas modconv kernels both consume the
+  same dequantized weights with no per-backend code.
+
+Scales are recomputed **deterministically at bundle load** (pure
+numpy, no rng), so two replicas — or a cold restart — always derive
+bit-identical quantized trees from the same checkpoint; only the
+compiled executables ride the warm-start manifest, fingerprinted with
+``serve_precision`` so an int8w blob can never warm-start a f32
+service (serve/warmstart.py).
+
+The A/B half (`cost_report`, `fidelity_report`) measures what the
+quantization bought and what it cost: AOT ``memory_analysis`` /
+``cost_analysis`` deltas per image, and output error against the f32
+reference at the declared tolerances below.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+# Declared fidelity tolerances per serve_precision: max |out - ref|
+# normalized by the f32 reference's dynamic range (max|ref|), over the
+# bucketed-parity fixtures.  f32 is the reference (exact); bf16 loses
+# activation mantissa only (weights and the declared islands stay f32);
+# int8w adds ~0.4% per-weight rounding error that accumulates through
+# the synthesis depth.  Exceeding these is a regression, not noise —
+# they carry 2-3x headroom over measured tiny-config error.
+FIDELITY_TOLERANCES: Dict[str, float] = {
+    "f32": 0.0,
+    "bf16": 0.05,
+    "int8w": 0.20,
+}
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    return str(getattr(last, "key", getattr(last, "name", last)))
+
+
+def is_kernel(path, leaf) -> bool:
+    """The quantization predicate: exactly the equalized-LR kernels.
+    All three layer classes name their kernel ``"w"`` with ndim 2
+    (dense [fan_in, out]) or 4 (conv [kh, kw, cin, cout]); everything
+    else under that name check — ``b``, ``pos_emb``, ``d_queries``,
+    ``const``, gates, ``noise_strength`` — fails one of the two
+    conditions."""
+    return _leaf_name(path) == "w" and getattr(leaf, "ndim", 0) in (2, 4)
+
+
+def quantize_leaf(w: np.ndarray):
+    """One kernel → QuantizedWeight(q int8 same-shape, scale fp32
+    per-output-channel over the last axis, keepdims)."""
+    from gansformer_tpu.ops import QuantizedWeight
+
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    scale = (amax / 127.0).astype(np.float32)
+    # all-zero channels (possible at init): scale 1 keeps dequant exact
+    scale = np.where(scale > 0.0, scale, np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return QuantizedWeight(q, scale)
+
+
+def quantize_params(params: Any) -> Any:
+    """The full params tree with every equalized-LR kernel replaced by
+    a ``QuantizedWeight`` leaf.  Deterministic (pure numpy) — replicas
+    quantizing the same checkpoint agree bit-for-bit."""
+    import jax
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (quantize_leaf(leaf) if is_kernel(path, leaf)
+                            else leaf),
+        params)
+
+
+def param_tree_bytes(params: Any) -> int:
+    """Host-side truth: total bytes of the params-tree leaves (a
+    QuantizedWeight contributes its int8 codes plus its fp32 scales)."""
+    import jax
+
+    return int(sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                   for l in jax.tree_util.tree_leaves(params)))
+
+
+# -- A/B reports -------------------------------------------------------------
+
+def _synth_compiled(bundle, precision: str, bucket: int):
+    from gansformer_tpu.serve.programs import ServePrograms
+
+    p = ServePrograms(bundle, buckets=(bucket,), manifest_dir=None,
+                      warm_start=False, serve_precision=precision)
+    return p, p._get("synthesize", bucket)
+
+
+def _memory_stats(compiled) -> Dict[str, Optional[float]]:
+    try:
+        ma = compiled.memory_analysis()
+        return {"argument_bytes": float(ma.argument_size_in_bytes),
+                "output_bytes": float(ma.output_size_in_bytes),
+                "temp_bytes": float(ma.temp_size_in_bytes)}
+    except Exception:
+        return {"argument_bytes": None, "output_bytes": None,
+                "temp_bytes": None}
+
+
+def cost_report(bundle, bucket: int = 4,
+                precisions: Sequence[str] = ("f32", "bf16", "int8w")
+                ) -> Dict[str, Any]:
+    """AOT cost A/B across the precision axis at one bucket: FLOPs and
+    bytes per image from the compiled executables (deterministic on
+    CPU — XLA cost analysis over the partitioned module, no runtime
+    sampling), plus the host-side params-tree bytes.
+
+    ``param_bytes_per_image`` reads the compiled ARGUMENT bytes: jax
+    DCEs unused flat inputs at trace time, so the synth executable's
+    argument set is exactly the synthesis-reachable params plus the
+    O(bucket) request rows — the bytes a weight-stationary serving
+    floor actually holds per replica.
+    """
+    from gansformer_tpu.utils.benchcheck import flops_of
+
+    out: Dict[str, Any] = {"bucket": int(bucket), "per_precision": {}}
+    for prec in precisions:
+        p, compiled = _synth_compiled(bundle, prec, bucket)
+        mem = _memory_stats(compiled)
+        flops = flops_of(compiled)
+        arg_b = mem["argument_bytes"]
+        # request-row bytes (w_avg, ws, psi, rng, tags — everything
+        # that is NOT weights) come off the top: the headline is
+        # PARAMETER bytes, the weight traffic a replica re-reads per
+        # dispatched image
+        req_b = sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+                    for a in p._abstract_args("synthesize", bucket)[1:])
+        param_b = (arg_b - req_b) if arg_b else None
+        rec = {
+            "flops_per_image": (flops / bucket) if flops else None,
+            "argument_bytes": arg_b,
+            "request_bytes": float(req_b),
+            "param_bytes_per_image":
+                (param_b / bucket) if param_b else None,
+            "output_bytes_per_image":
+                (mem["output_bytes"] / bucket) if mem["output_bytes"]
+                else None,
+            "temp_bytes": mem["temp_bytes"],
+            "params_tree_bytes": param_tree_bytes(p._synth_params),
+        }
+        out["per_precision"][prec] = rec
+    f32 = out["per_precision"].get("f32", {})
+    for prec in precisions:
+        if prec == "f32":
+            continue
+        rec = out["per_precision"][prec]
+        for num, den, key in (
+                (f32.get("param_bytes_per_image"),
+                 rec.get("param_bytes_per_image"), "param_bytes_ratio"),
+                (f32.get("params_tree_bytes"),
+                 rec.get("params_tree_bytes"), "tree_bytes_ratio"),
+                (f32.get("flops_per_image"),
+                 rec.get("flops_per_image"), "flops_ratio")):
+            rec[f"{key}_vs_f32"] = (num / den) if num and den else None
+    return out
+
+
+def fidelity_report(bundle, precision: str, bucket: int = 4,
+                    seeds: Optional[Sequence[int]] = None,
+                    psi: float = 0.7,
+                    tolerance: Optional[float] = None) -> Dict[str, Any]:
+    """Output error of a precision variant against the f32 reference on
+    the bucketed-parity fixtures: both programs synthesize the SAME
+    cached w rows (mapping always runs f32), same ψ, same noise tags —
+    the only delta is the synth program's precision.  ``rel_err`` is
+    max |out - ref| / max|ref|; ``ok`` grades it against the declared
+    tolerance."""
+    if tolerance is None:
+        tolerance = FIDELITY_TOLERANCES[precision]
+    if seeds is None:
+        seeds = list(range(1, bucket + 1))
+    seeds = np.asarray(seeds, np.int32)
+    if len(seeds) != bucket:
+        raise ValueError(f"need exactly {bucket} seeds, got {len(seeds)}")
+    ref_p, _ = _synth_compiled(bundle, "f32", bucket)
+    var_p, _ = _synth_compiled(bundle, precision, bucket)
+    ws = np.asarray(ref_p.map_seeds(seeds))
+    psis = np.full((bucket,), psi, np.float32)
+    rng = np.array([7, 11], np.uint32)
+    tags = seeds.astype(np.uint32)
+    ref = np.asarray(ref_p.synthesize(ws, psis, rng, tags),
+                     np.float32)
+    out = np.asarray(var_p.synthesize(ws, psis, rng, tags),
+                     np.float32)
+    denom = float(np.max(np.abs(ref))) or 1.0
+    abs_err = float(np.max(np.abs(out - ref)))
+    rel_err = abs_err / denom
+    return {
+        "precision": precision,
+        "bucket": int(bucket),
+        "psi": float(psi),
+        "max_abs_err": abs_err,
+        "ref_dynamic_range": denom,
+        "rel_err": rel_err,
+        "tolerance": float(tolerance),
+        "ok": bool(rel_err <= tolerance),
+    }

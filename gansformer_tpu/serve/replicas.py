@@ -1,0 +1,431 @@
+"""Replica-per-device serving: placement, routing, autoscaled dispatch.
+
+The single-dispatcher ``GenerationService`` saturates exactly one
+accelerator; every additional chip on the host idles.  This module
+scales the serving floor *across* local devices the way the TPU serving
+fleets do — one full replica per chip, not one sharded model:
+
+* **Placement** — each ``Replica`` owns a ``ServePrograms`` pinned to
+  one ``jax.local_devices()`` entry: the params bundle is ``device_put``
+  onto that device and every AOT executable is compiled against
+  ``SingleDeviceSharding`` abstract args, so dispatch never migrates
+  data through device 0.  Warm-start manifests are fingerprinted with
+  the device ordinal (serve/warmstart.py), so replica 3's serialized
+  executables can never warm-start replica 0.
+* **Routing** — ``submit`` assigns each request to the least-loaded
+  *accepting* replica (queued + in-flight tickets, ``service.load()``).
+  A replica whose breaker tripped or that is draining stops accepting
+  and the router walks past it; its queue-compaction/quarantine
+  machinery is untouched — per-replica failure containment composes
+  with fleet routing instead of replacing it.
+* **Autoscaling** — an optional controller thread samples fleet
+  saturation every tick and scales OUT on sustained queue pressure
+  (before any breaker trips — saturation is a leading indicator,
+  breaker trips a trailing one) and IN on batch-fill collapse with an
+  empty queue, under hysteresis (consecutive-tick counts + cooldown)
+  and ``min_replicas``/``max_replicas`` bounds.  Deactivated replicas
+  drain cleanly; their compiled ``ServePrograms`` stay cached so
+  reactivation pays zero compiles.
+
+Determinism contract: replica placement NEVER enters the rng path.
+``serve_synth`` derives per-row noise from the request seed (the tags
+row), the w rows are pure functions of the seed, so the same request
+stream produces bit-identical images through 1 or N replicas (pinned by
+tests/test_serve_replicas.py).
+
+Telemetry (fleet level — members export ``serve/replica<i>/...``):
+``serve/replicas`` (active count), ``serve/health_state`` /
+``serve/dispatcher_alive`` (any-alive) / ``serve/queue_depth_now`` (sum)
+/ ``serve/queue_bound`` (sum), counters ``serve/scale_out_total`` /
+``serve/scale_in_total``, and router-side ``serve/replica<i>/requests_total``
+(dispatch share).  Scale/breaker events carry timestamps in
+``ReplicaSet.events`` so the chaos drill can assert scale-out fired
+*before* the first breaker trip.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from gansformer_tpu.obs import registry as telemetry
+from gansformer_tpu.serve.programs import (
+    DEFAULT_BUCKETS, SERVE_PRECISIONS, ServePrograms)
+from gansformer_tpu.serve.service import (
+    HEALTH_CLOSED, HEALTH_UNHEALTHY, _HEALTH_NAMES,
+    GenerationService, ServiceClosed, ServiceUnhealthy, Ticket)
+
+
+class Replica:
+    """One device-pinned serving member: ordinal + device + programs +
+    (possibly recreated) service.  ``programs`` survives deactivation —
+    the compiled executables are the expensive part."""
+
+    def __init__(self, ordinal: int, device: Any,
+                 programs: ServePrograms) -> None:
+        self.ordinal = int(ordinal)
+        self.device = device
+        self.programs = programs
+        self.service: Optional[GenerationService] = None
+
+    @property
+    def active(self) -> bool:
+        return self.service is not None
+
+
+class ReplicaSet:
+    """The fleet: replica-per-device placement + least-loaded routing +
+    optional autoscaler.  Drop-in supersedes a bare GenerationService
+    for the serving entry points (same ``submit``/``health``/``close``
+    verbs; ``cli/serve.py`` and ``scripts/loadtest_serve.py`` ride it).
+    """
+
+    def __init__(self, bundle: Any,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 manifest_dir: Optional[str] = None,
+                 warm_start: bool = True,
+                 serve_precision: str = "f32",
+                 replicas: Optional[int] = None,
+                 min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 autoscale: bool = False,
+                 autoscale_interval_s: float = 0.25,
+                 scale_out_saturation: float = 0.8,
+                 scale_out_ticks: int = 3,
+                 scale_in_fill: float = 0.25,
+                 scale_in_ticks: int = 8,
+                 cooldown_s: float = 2.0,
+                 service_kwargs: Optional[Dict[str, Any]] = None) -> None:
+        import jax
+
+        if serve_precision not in SERVE_PRECISIONS:
+            raise ValueError(f"serve_precision must be one of "
+                             f"{SERVE_PRECISIONS}, got {serve_precision!r}")
+        self._devices = list(jax.local_devices())
+        n_dev = len(self._devices)
+        self.max_replicas = min(int(max_replicas or n_dev), n_dev)
+        self.min_replicas = max(1, min(int(min_replicas),
+                                       self.max_replicas))
+        start = int(replicas) if replicas is not None else self.min_replicas
+        if not (1 <= start <= self.max_replicas):
+            raise ValueError(
+                f"replicas={start} out of range [1, {self.max_replicas}] "
+                f"({n_dev} local device(s))")
+        self._bundle = bundle
+        self._mk_programs = lambda dev: ServePrograms(
+            bundle, buckets=buckets, manifest_dir=manifest_dir,
+            warm_start=warm_start, serve_precision=serve_precision,
+            device=dev)
+        self.serve_precision = serve_precision
+        self._service_kwargs = dict(service_kwargs or {})
+        self._lock = threading.RLock()
+        self._replicas: List[Replica] = []
+        self._closed = False
+        # timestamped scale/breaker event log — the chaos drill's
+        # ordering evidence (monotonic clock: compare t's, never walls)
+        self.events: List[Dict[str, Any]] = []
+        self._tripped_seen: set = set()
+        # autoscaler hysteresis state
+        self._sat_ticks = 0
+        self._idle_ticks = 0
+        self._last_scale_t = -float("inf")
+        self._fill_marks: Dict[int, tuple] = {}
+        self._autoscale_cfg = {
+            "interval_s": float(autoscale_interval_s),
+            "out_saturation": float(scale_out_saturation),
+            "out_ticks": int(scale_out_ticks),
+            "in_fill": float(scale_in_fill),
+            "in_ticks": int(scale_in_ticks),
+            "cooldown_s": float(cooldown_s),
+        }
+        for name in ("serve/scale_out_total", "serve/scale_in_total"):
+            telemetry.counter(name)
+        for _ in range(start):
+            self._activate_one(record_event=False)
+        self._update_fleet_gauges()
+        self._scaler: Optional[threading.Thread] = None
+        self._scaler_stop = threading.Event()
+        if autoscale:
+            self._scaler = threading.Thread(
+                target=self._autoscale_loop, name="serve-autoscaler",
+                daemon=True)
+            self._scaler.start()
+
+    # -- membership ----------------------------------------------------------
+
+    @property
+    def active_replicas(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self._replicas if r.active]
+
+    @property
+    def n_active(self) -> int:
+        return len(self.active_replicas)
+
+    def _activate_one(self, record_event: bool = True) -> Optional[Replica]:
+        """Bring up the lowest inactive ordinal (creating the Replica —
+        and its device-pinned programs — on first activation; later
+        activations reuse the cached programs: zero compiles)."""
+        with self._lock:
+            if self._closed:
+                return None
+            target = next((r for r in self._replicas if not r.active), None)
+            if target is None:
+                if len(self._replicas) >= self.max_replicas:
+                    return None
+                ordinal = len(self._replicas)
+                target = Replica(ordinal, self._devices[ordinal],
+                                 self._mk_programs(self._devices[ordinal]))
+                self._replicas.append(target)
+            target.service = GenerationService(
+                target.programs, replica_id=target.ordinal,
+                **self._service_kwargs)
+            # router-side dispatch-share counter, explicit zero up front
+            telemetry.counter(
+                f"serve/replica{target.ordinal}/requests_total")
+            if record_event:
+                telemetry.counter("serve/scale_out_total").inc()
+                self.events.append({"kind": "scale_out",
+                                    "replica": target.ordinal,
+                                    "n_active": self.n_active,
+                                    "t": time.monotonic()})
+            self._update_fleet_gauges()
+            return target
+
+    def _deactivate_one(self, timeout: float = 30.0) -> Optional[int]:
+        """Drain + retire the highest-ordinal active replica (programs
+        stay cached for reactivation)."""
+        with self._lock:
+            candidates = [r for r in self._replicas if r.active]
+            if len(candidates) <= self.min_replicas:
+                return None
+            target = candidates[-1]
+            svc, target.service = target.service, None
+            telemetry.counter("serve/scale_in_total").inc()
+            self.events.append({"kind": "scale_in",
+                                "replica": target.ordinal,
+                                "n_active": self.n_active,
+                                "t": time.monotonic()})
+            self._update_fleet_gauges()
+        svc.close(timeout=timeout)
+        return target.ordinal
+
+    scale_out = _activate_one
+    scale_in = _deactivate_one
+
+    def warm_start(self) -> Dict[str, Any]:
+        """Warm-start every ACTIVE replica's programs from its
+        per-ordinal manifest (merged {loaded, compiled, seconds}).
+        Replicas the autoscaler activates later warm lazily — their
+        cold compiles ride the dispatch watchdog's startup grace."""
+        out = {"loaded": 0, "compiled": 0, "seconds": 0.0}
+        for r in self.active_replicas:
+            stats = r.programs.warm_start()
+            out["loaded"] += stats["loaded"]
+            out["compiled"] += stats["compiled"]
+            out["seconds"] += stats["seconds"]
+        return out
+
+    # -- routing -------------------------------------------------------------
+
+    def submit(self, seed: int, psi: float = 0.7, label=None,
+               deadline_s: Optional[float] = None) -> Ticket:
+        """Route one request to the least-loaded accepting replica.  A
+        replica that refuses (sheds / trips between the load sample and
+        the submit) is skipped and the next-least-loaded one tried; the
+        LAST refusal propagates typed when every replica refused."""
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("replica set is closed")
+            ranked = sorted(
+                (r for r in self._replicas
+                 if r.active and r.service.accepting()),
+                key=lambda r: r.service.load())
+        if not ranked:
+            raise ServiceUnhealthy(
+                "no accepting replica (all tripped, draining, or closed)")
+        last_err: Optional[Exception] = None
+        for r in ranked:
+            try:
+                t = r.service.submit(seed, psi, label, deadline_s)
+            except Exception as e:          # typed serve errors only
+                last_err = e
+                continue
+            telemetry.counter(
+                f"serve/replica{r.ordinal}/requests_total").inc()
+            self._update_fleet_gauges()
+            return t
+        raise last_err
+
+    # -- fleet health --------------------------------------------------------
+
+    def _update_fleet_gauges(self) -> None:
+        with self._lock:
+            active = [r for r in self._replicas if r.active]
+            telemetry.gauge("serve/replicas").set(len(active))
+            if not active:
+                telemetry.gauge("serve/dispatcher_alive").set(0)
+                telemetry.gauge("serve/queue_depth_now").set(0)
+                telemetry.gauge("serve/health_state").set(
+                    HEALTH_CLOSED if self._closed else HEALTH_UNHEALTHY)
+                return
+            depth = bound = 0
+            any_alive = False
+            for r in active:
+                svc = r.service
+                with svc._cv:
+                    depth += len(svc._pending)
+                bound += svc._max_queue_depth
+                any_alive = any_alive or svc._worker.alive
+            telemetry.gauge("serve/dispatcher_alive").set(
+                1 if any_alive else 0)
+            telemetry.gauge("serve/queue_depth_now").set(depth)
+            telemetry.gauge("serve/queue_bound").set(bound)
+
+    def health(self) -> dict:
+        """Fleet snapshot: healthiest-member state (the fleet serves as
+        long as SOME replica can), per-replica sub-reports, and the
+        scale-event tail.  Sets the fleet gauges as a side effect —
+        mirrors ``GenerationService.health``."""
+        with self._lock:
+            members = list(self._replicas)
+            closed = self._closed
+        reports = []
+        for r in members:
+            if r.active:
+                reports.append(r.service.health())
+            else:
+                reports.append({"state": "inactive", "state_code": None,
+                                "replica_id": r.ordinal, "reasons": [],
+                                "queue_depth": 0})
+        codes = [rep["state_code"] for rep in reports
+                 if rep["state_code"] is not None]
+        state = min(codes) if codes else (
+            HEALTH_CLOSED if closed else HEALTH_UNHEALTHY)
+        reasons: List[str] = []
+        for rep in reports:
+            for why in rep.get("reasons", []):
+                reasons.append(f"replica {rep['replica_id']}: {why}")
+        self._update_fleet_gauges()
+        telemetry.gauge("serve/health_state").set(state)
+        return {"state": _HEALTH_NAMES[state], "state_code": state,
+                "replicas": reports, "n_active": self.n_active,
+                "n_devices": len(self._devices),
+                "reasons": reasons,
+                "scale_events": list(self.events[-16:])}
+
+    # -- autoscaler ----------------------------------------------------------
+
+    def _autoscale_tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One controller step (exposed for the drill tests — the
+        thread just loops this).  Returns 'out'/'in' when it scaled."""
+        cfg = self._autoscale_cfg
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            active = [r for r in self._replicas if r.active]
+            depth = bound = 0
+            batches = 0
+            fills: List[float] = []
+            for r in active:
+                svc = r.service
+                if svc._tripped and r.ordinal not in self._tripped_seen:
+                    # trailing failure signal, logged for the drill's
+                    # scale-out-before-breaker ordering check
+                    self._tripped_seen.add(r.ordinal)
+                    self.events.append({"kind": "breaker_trip",
+                                        "replica": r.ordinal,
+                                        "n_active": len(active),
+                                        "t": now})
+                with svc._cv:
+                    depth += len(svc._pending)
+                bound += svc._max_queue_depth
+                h = telemetry.histogram(
+                    svc._g("serve/batch_fill"))
+                prev_n, prev_sum = self._fill_marks.get(r.ordinal, (0, 0.0))
+                dn, ds = h.count - prev_n, h.sum - prev_sum
+                self._fill_marks[r.ordinal] = (h.count, h.sum)
+                batches += dn
+                if dn > 0:
+                    fills.append(ds / dn)
+        saturation = (depth / bound) if bound else 0.0
+        recent_fill = (sum(fills) / len(fills)) if fills else None
+        # -- scale OUT: sustained saturation, a LEADING indicator — it
+        # fires ticks before retries/hangs could trip any breaker
+        if saturation >= cfg["out_saturation"]:
+            self._sat_ticks += 1
+        else:
+            self._sat_ticks = 0
+        # -- scale IN: batch-fill collapse (dispatches running mostly
+        # padding) or full idleness, with an empty queue
+        collapsed = (depth == 0
+                     and (batches == 0
+                          or (recent_fill is not None
+                              and recent_fill < cfg["in_fill"])))
+        self._idle_ticks = self._idle_ticks + 1 if collapsed else 0
+        in_cooldown = (now - self._last_scale_t) < cfg["cooldown_s"]
+        if (self._sat_ticks >= cfg["out_ticks"] and not in_cooldown
+                and self.n_active < self.max_replicas):
+            if self._activate_one() is not None:
+                self._sat_ticks = 0
+                self._last_scale_t = now
+                return "out"
+        if (self._idle_ticks >= cfg["in_ticks"] and not in_cooldown
+                and self.n_active > self.min_replicas):
+            if self._deactivate_one() is not None:
+                self._idle_ticks = 0
+                self._last_scale_t = now
+                return "in"
+        return None
+
+    def _autoscale_loop(self) -> None:
+        interval = self._autoscale_cfg["interval_s"]
+        while not self._scaler_stop.wait(interval):
+            try:
+                self._autoscale_tick()
+            except Exception:
+                # the controller must never take the serving floor down;
+                # a bad tick is dropped and the next one resamples
+                pass
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def install_signal_drain(self, grace_s: float = 30.0) -> bool:
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _on_term(signum, frame):
+            threading.Thread(target=self.close,
+                             kwargs={"timeout": grace_s},
+                             name="serve-fleet-sigterm-drain",
+                             daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            return False
+        return True
+
+    def close(self, timeout: float = 30.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            members = [r for r in self._replicas if r.active]
+        self._scaler_stop.set()
+        if self._scaler is not None:
+            self._scaler.join(timeout=max(1.0, timeout))
+        for r in members:
+            svc, r.service = r.service, None
+            svc.close(timeout=timeout)
+        with self._lock:
+            self._update_fleet_gauges()
+            telemetry.gauge("serve/health_state").set(HEALTH_CLOSED)
+
+    def __enter__(self) -> "ReplicaSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -262,10 +262,25 @@ class GenerationService:
                  restart_backoff_max_s: float = 2.0,
                  hang_after_s: Optional[float] = 300.0,
                  hang_startup_grace_s: float = 1800.0,
-                 quarantine_after: int = 2):
+                 quarantine_after: int = 2,
+                 replica_id: Optional[int] = None):
         if max_queue_depth < 1:
             raise ValueError(f"max_queue_depth must be >= 1, got "
                              f"{max_queue_depth}")
+        # Replica member mode (serve/replicas.ReplicaSet, ISSUE 20):
+        # the instance LIVENESS gauges move to serve/replica<i>/... so
+        # N members never fight over one global gauge — the fleet-level
+        # serve/health_state, serve/dispatcher_alive (any-alive),
+        # serve/queue_depth_now (sum) and serve/queue_bound are owned
+        # by the ReplicaSet.  Counters and the shared histograms stay
+        # global (they sum correctly across members); dispatch
+        # additionally attributes images/fill/latency per replica.
+        self.replica_id = replica_id
+        if replica_id is None:
+            self._g = lambda name: name
+        else:
+            pfx = f"serve/replica{int(replica_id)}/"
+            self._g = lambda name: pfx + name[len("serve/"):]
         self.programs = programs
         self._max_bucket = programs.buckets[-1]
         self._fill_wait_s = max(0.0, max_fill_wait_ms) / 1000.0
@@ -322,12 +337,19 @@ class GenerationService:
             telemetry.counter(name)
         telemetry.gauge("reqtrace/enabled").set(
             1.0 if reqtrace.get_reqtracer().enabled else 0.0)
-        telemetry.gauge("serve/queue_bound").set(self._max_queue_depth)
-        telemetry.gauge("serve/health_state").set(HEALTH_READY)
-        telemetry.gauge("serve/queue_depth_now").set(0)
+        if replica_id is not None:
+            # replica-member instruments: materialized up front so an
+            # idle replica still exports explicit zeros (the fleet
+            # schema check reads absence as rotted wiring)
+            telemetry.counter(self._g("serve/images_total"))
+            telemetry.histogram(self._g("serve/batch_ms"))
+            telemetry.histogram(self._g("serve/batch_fill"))
+        telemetry.gauge(self._g("serve/queue_bound")).set(self._max_queue_depth)
+        telemetry.gauge(self._g("serve/health_state")).set(HEALTH_READY)
+        telemetry.gauge(self._g("serve/queue_depth_now")).set(0)
         self._worker = LoopWorker(self._serve_dispatch,
                                   "serve/dispatch").start()
-        telemetry.gauge("serve/dispatcher_alive").set(1)
+        telemetry.gauge(self._g("serve/dispatcher_alive")).set(1)
         self._monitor = threading.Thread(target=self._supervise_dispatch,
                                          name="serve-supervisor",
                                          daemon=True)
@@ -375,7 +397,7 @@ class GenerationService:
             else:
                 self._pending.append(t)
                 rt.event(t.rid, "admitted", depth=len(self._pending))
-                telemetry.gauge("serve/queue_depth_now").set(
+                telemetry.gauge(self._g("serve/queue_depth_now")).set(
                     len(self._pending))
                 self._cv.notify()
         self._settle_dropped(dropped)
@@ -400,6 +422,20 @@ class GenerationService:
                 t._fail(Expired(
                     f"request (seed={t.seed}) deadline passed "
                     f"before dispatch"))
+
+    def load(self) -> int:
+        """Router signal (serve/replicas): queued + in-flight tickets —
+        the work this replica would have to finish before a newly
+        assigned request runs."""
+        with self._cv:
+            return len(self._pending) + len(self._inflight)
+
+    def accepting(self) -> bool:
+        """True iff ``submit`` would not refuse outright (not closed,
+        breaker not open).  Queue saturation is NOT checked here — the
+        router prefers a deep healthy queue over a tripped replica."""
+        with self._cv:
+            return not self._stop and not self._tripped
 
     def health(self) -> dict:
         """Point-in-time health snapshot: ``ready`` / ``degraded`` /
@@ -448,9 +484,10 @@ class GenerationService:
                     f"mostly stale (recompiling at serve time)")
             if reasons:
                 state = HEALTH_DEGRADED
-        telemetry.gauge("serve/health_state").set(state)
-        telemetry.gauge("serve/queue_depth_now").set(depth)
+        telemetry.gauge(self._g("serve/health_state")).set(state)
+        telemetry.gauge(self._g("serve/queue_depth_now")).set(depth)
         return {"state": _HEALTH_NAMES[state], "state_code": state,
+                "replica_id": self.replica_id,
                 "reasons": reasons, "queue_depth": depth,
                 "queue_bound": self._max_queue_depth,
                 "dispatcher_alive": alive,
@@ -505,7 +542,7 @@ class GenerationService:
             with self._cv:
                 leftovers = list(self._pending)
                 self._pending.clear()
-                telemetry.gauge("serve/queue_depth_now").set(0)
+                telemetry.gauge(self._g("serve/queue_depth_now")).set(0)
             # dead tickets swept at drain still count as dropped-before-
             # dispatch (and expired ones resolve with the typed Expired),
             # exactly as a pop would have counted them
@@ -528,15 +565,15 @@ class GenerationService:
             failed += self._fail_inflight(ServiceClosed(
                 "service closed mid-batch (dispatcher did not drain "
                 "within the grace window)"))
-            telemetry.gauge("serve/dispatcher_alive").set(
+            telemetry.gauge(self._g("serve/dispatcher_alive")).set(
                 1.0 if self._worker.alive else 0.0)
             if failed:
                 self._drain_failed = True
-                telemetry.gauge("serve/health_state").set(HEALTH_UNHEALTHY)
+                telemetry.gauge(self._g("serve/health_state")).set(HEALTH_UNHEALTHY)
             elif not self._tripped:
                 # a clean drain exports as closed (3) even when the
                 # caller never polls health() again
-                telemetry.gauge("serve/health_state").set(HEALTH_CLOSED)
+                telemetry.gauge(self._g("serve/health_state")).set(HEALTH_CLOSED)
 
     def __enter__(self) -> "GenerationService":
         return self
@@ -569,7 +606,7 @@ class GenerationService:
             self._gen += 1
             leftovers = list(self._pending)
             self._pending.clear()
-            telemetry.gauge("serve/queue_depth_now").set(0)
+            telemetry.gauge(self._g("serve/queue_depth_now")).set(0)
             self._cv.notify_all()
         now = time.perf_counter()
         self._settle_dropped([t for t in leftovers
@@ -579,8 +616,8 @@ class GenerationService:
             t._fail(ServiceUnhealthy(
                 f"circuit breaker open after {self._restarts} dispatcher "
                 f"restart(s): {cause}"))
-        telemetry.gauge("serve/health_state").set(HEALTH_UNHEALTHY)
-        telemetry.gauge("serve/dispatcher_alive").set(0)
+        telemetry.gauge(self._g("serve/health_state")).set(HEALTH_UNHEALTHY)
+        telemetry.gauge(self._g("serve/dispatcher_alive")).set(0)
 
     def _supervise_dispatch(self) -> None:
         """The serving twin of ``supervise/supervisor.py``: wait for the
@@ -633,7 +670,7 @@ class GenerationService:
                 # re-flag an already-abandoned worker as hung
                 self._busy_since = None
                 self._busy_cold = False
-            telemetry.gauge("serve/dispatcher_alive").set(0)
+            telemetry.gauge(self._g("serve/dispatcher_alive")).set(0)
             # Progress resets the escalation (the supervisor.py shape):
             # a dispatcher that served batches between deaths restarts
             # eagerly forever; only BACK-TO-BACK no-progress deaths
@@ -668,8 +705,8 @@ class GenerationService:
             telemetry.counter("serve/dispatcher_restarts_total").inc()
             self._worker = LoopWorker(self._serve_dispatch,
                                       "serve/dispatch").start()
-            telemetry.gauge("serve/dispatcher_alive").set(1)
-            telemetry.gauge("serve/health_state").set(HEALTH_DEGRADED)
+            telemetry.gauge(self._g("serve/dispatcher_alive")).set(1)
+            telemetry.gauge(self._g("serve/health_state")).set(HEALTH_DEGRADED)
 
     # -- consumer side (dispatcher thread) -----------------------------------
 
@@ -736,7 +773,7 @@ class GenerationService:
                     else:
                         batch.append(t)
                 telemetry.histogram("serve/queue_depth").observe(depth)
-                telemetry.gauge("serve/queue_depth_now").set(
+                telemetry.gauge(self._g("serve/queue_depth_now")).set(
                     len(self._pending))
                 if batch:
                     self._inflight = list(batch)
@@ -808,8 +845,18 @@ class GenerationService:
                     self._busy_cold = cold
                 psi = np.full((bucket,), 1.0, np.float32)
                 psi[:n] = [t.psi for t in batch]
-                noise = np.array([self._noise_seed, self._batches],
-                                 np.uint32)
+                # Noise identity rides the REQUEST (its seed), not the
+                # batch counter: serve_synth folds tags[i] into the rng
+                # per row, so an image is a pure function of
+                # (seed, psi, noise_seed) no matter which batch,
+                # replica, or restart served it — replica placement
+                # must never enter the rng path (ISSUE 20; pinned by
+                # the 1-vs-N determinism test).  Padding rows repeat
+                # the last real tag, mirroring the ws padding.
+                noise = np.array([self._noise_seed, 0], np.uint32)
+                tags = np.full((bucket,), batch[-1].seed & 0xFFFFFFFF,
+                               np.uint32)
+                tags[:n] = [t.seed & 0xFFFFFFFF for t in batch]
 
                 def map_misses():
                     nonlocal fail_bucket
@@ -844,7 +891,8 @@ class GenerationService:
                     # the copy with the synthesis compute.  miss
                     # bucket == synth bucket here (same n).
                     ws_dev = map_misses()
-                    imgs_dev = programs.synthesize(ws_dev, psi, noise)
+                    imgs_dev = programs.synthesize(ws_dev, psi, noise,
+                                                   tags)
                     for t in batch:
                         rt.event(t.rid, "synth", bucket=bucket)
                     with span("serve_fetch"):
@@ -862,7 +910,7 @@ class GenerationService:
                     # real row (row-independence keeps the prefix
                     # bit-identical)
                     ws = np.stack(rows + [rows[-1]] * (bucket - n))
-                    imgs_dev = programs.synthesize(ws, psi, noise)
+                    imgs_dev = programs.synthesize(ws, psi, noise, tags)
                     for t in batch:
                         rt.event(t.rid, "synth", bucket=bucket)
                 with span("serve_fetch"):
@@ -901,6 +949,16 @@ class GenerationService:
                 batch_s = time.perf_counter() - t0
                 telemetry.histogram("serve/batch_ms").observe(
                     batch_s * 1000.0, exemplar=batch[0].rid)
+                if self.replica_id is not None:
+                    # per-replica attribution (globals above keep
+                    # moving — they are the fleet sums the schema lint
+                    # and the doctor read)
+                    telemetry.counter(
+                        self._g("serve/images_total")).inc(delivered)
+                    telemetry.histogram(
+                        self._g("serve/batch_ms")).observe(batch_s * 1000.0)
+                    telemetry.histogram(
+                        self._g("serve/batch_fill")).observe(n / bucket)
                 # the batch→requests causal link in events.jsonl
                 rt.batch_span(self._batches, bucket,
                               [t.rid for t in batch], t0, batch_s)

@@ -56,7 +56,12 @@ from gansformer_tpu.obs import registry as telemetry
 from gansformer_tpu.obs.registry import atomic_write_text
 
 MANIFEST = "manifest.json"
-PROTOCOL = 1
+# 2: serve_synth takes per-row noise tags (replica-count-independent
+# noise; ISSUE 20) and the fingerprint carries serve_precision +
+# device_ordinal — protocol-1 manifests deserialize fine but would hand
+# back executables with the OLD call signature, so they must read as
+# stale, not as warm hits.
+PROTOCOL = 2
 
 
 def backend_signature() -> Dict[str, Any]:
@@ -73,18 +78,27 @@ def backend_signature() -> Dict[str, Any]:
     }
 
 
-def fingerprint(model_cfg_json: str, kind: str, bucket: int) -> str:
+def fingerprint(model_cfg_json: str, kind: str, bucket: int,
+                serve_precision: str = "f32",
+                device_ordinal: int = 0) -> str:
     """Content hash of everything that determines the compiled program:
     the model architecture (full ModelConfig JSON — resolution, dtype,
     attention flavor, attention_backend AND conv_backend, …), the
-    program kind, the batch bucket, and the backend signature.  Two
-    processes agree on the fingerprint iff the serialized executable is
-    valid for both — in particular a manifest written under
-    ``conv_backend='pallas'`` can never warm-start an xla-conv service
-    (or vice versa): mixed-kernel executables are rejected as stale,
-    never silently served (ISSUE 14; pinned by tests/test_pallas_conv)."""
+    program kind, the batch bucket, the serving precision
+    (f32|bf16|int8w — an int8w executable takes a quantized params
+    signature a f32 service cannot feed), the device ordinal the
+    replica's programs are pinned to (ISSUE 20: executables carry their
+    device placement through serialization), and the backend signature.
+    Two processes agree on the fingerprint iff the serialized
+    executable is valid for both — in particular a manifest written
+    under ``conv_backend='pallas'`` can never warm-start an xla-conv
+    service (or vice versa): mixed-kernel executables are rejected as
+    stale, never silently served (ISSUE 14; pinned by
+    tests/test_pallas_conv)."""
     payload = json.dumps({"model": json.loads(model_cfg_json),
                           "kind": kind, "bucket": bucket,
+                          "serve_precision": serve_precision,
+                          "device_ordinal": int(device_ordinal),
                           **backend_signature()}, sort_keys=True)
     return hashlib.sha256(payload.encode()).hexdigest()
 

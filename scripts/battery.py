@@ -136,11 +136,19 @@ def default_stages():
         #     archived per window so the artifact's p99 / worst-request
         #     IDs resolve to full timelines (gansformer-telemetry
         #     requests {win} --id <rid>) long after the run.
+        #     --autoscale (ISSUE 20): the run rides a ReplicaSet —
+        #     replica-per-chip placement with the controller free to
+        #     scale across the window's devices — so the artifact
+        #     carries per-replica attribution (requests / img/s /
+        #     batch-fill / dispatch share per device) and the
+        #     img_s_per_chip headline normalized by replicas USED, not
+        #     chips present.  Works on a 1-device window too (the
+        #     fleet just never scales past its only member).
         stage("serve_loadtest", 900, "serve_loadtest_tpu.json",
               [py, "scripts/loadtest_serve.py",
                "--preset", "ffhq256-duplex", "--init", "random",
                "--buckets", "1,4,8", "--requests", "300", "--rate", "8",
-               "--duration-s", "600",
+               "--duration-s", "600", "--autoscale",
                "--manifest-dir", ".serve_manifest",
                "--requests-out", "{win}/requests.jsonl",
                "--json-out", "{win}/serve_loadtest.json"]),
@@ -161,12 +169,17 @@ def default_stages():
         #     asserts every hung/failed ticket reached a terminal trace
         #     event with a cause.  The shared persistent manifest means
         #     the flagship compiles were already paid by 6b.
+        #     --autoscale (ISSUE 20): the drill also runs the
+        #     controller's ordering contract on real hardware — the
+        #     artifact's autoscale section (scale-out before any
+        #     breaker trip, scale-in after recovery) is graded by the
+        #     doctor's serve_autoscale check (WARN, never FAIL).
         stage("serve_chaos", 600, "serve_chaos_tpu.json",
               ["sh", "-c",
                f"{py} scripts/loadtest_serve.py --chaos"
                f" --preset ffhq256-duplex --init random"
                f" --buckets 1,4,8 --queue-depth 16"
-               f" --burst-factor 4 --crash-at-batch 2"
+               f" --burst-factor 4 --crash-at-batch 2 --autoscale"
                f" --manifest-dir .serve_manifest"
                f" --json-out {{win}}/serve_chaos.json"
                f" --requests-out {{win}}/serve_chaos_requests.jsonl"

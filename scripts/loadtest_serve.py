@@ -58,11 +58,63 @@ def zipf_choice(rng, universe, size, s: float):
     return rng.choice(universe, size=size, p=p / p.sum())
 
 
+def trace_coverage_of(tickets):
+    """Terminal trace coverage over a ticket list (ISSUE 16/20
+    acceptance: 100 % of terminal requests traced).  Returns
+    (coverage dict, rid→terminal-row map)."""
+    from gansformer_tpu.obs import reqtrace as _reqtrace
+
+    rt = _reqtrace.get_reqtracer()
+    rids = [t.rid for t in tickets if getattr(t, "rid", None)]
+    terminal_rows = {r["rid"]: r for r in rt.recent()}
+    missing = [r for r in rids if r not in terminal_rows]
+    return ({"enabled": rt.enabled, "tickets": len(rids),
+             "terminal": sum(1 for r in rids if r in terminal_rows),
+             "missing_terminal_rids": missing,
+             "ok": not rt.enabled or not missing}, terminal_rows)
+
+
+def per_replica_report(snap0, snap1, wall_s, ordinals):
+    """Per-replica attribution (ISSUE 20 satellite): img/s, batch fill,
+    batch latency, and dispatch share per device, from telemetry DELTAS
+    between two registry snapshots (the registry is process-global and
+    cumulative — absolute values would bleed across runs)."""
+    def c_delta(name):
+        return (snap1["counters"].get(name, 0.0)
+                - snap0["counters"].get(name, 0.0))
+
+    def h_delta(name):
+        h1 = snap1["histograms"].get(name, {})
+        h0 = snap0["histograms"].get(name, {})
+        dn = (h1.get("count") or 0) - (h0.get("count") or 0)
+        ds = (h1.get("sum") or 0.0) - (h0.get("sum") or 0.0)
+        return (ds / dn) if dn > 0 else None
+
+    total_req = sum(
+        c_delta(f"serve/replica{i}/requests_total") for i in ordinals)
+    out = {}
+    for i in ordinals:
+        imgs = c_delta(f"serve/replica{i}/images_total")
+        req = c_delta(f"serve/replica{i}/requests_total")
+        out[str(i)] = {
+            "requests": req,
+            "images": imgs,
+            "img_per_s": round(imgs / max(wall_s, 1e-9), 2),
+            "batch_fill_mean": h_delta(f"serve/replica{i}/batch_fill"),
+            "batch_ms_mean": h_delta(f"serve/replica{i}/batch_ms"),
+            "dispatch_share": round(req / total_req, 4) if total_req
+            else 0.0,
+        }
+    return out
+
+
 def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
               crash_at_batch=2, deadline_s=None, zipf_s=1.1,
               seed_universe=64, manifest_dir=None, fill_wait_ms=0.0,
               wcache=4096, seed=0, restart_backoff_s=0.05,
-              grace_s=60.0):
+              grace_s=60.0, replicas=1, autoscale=False,
+              max_replicas=None, serve_precision="f32",
+              pressure_s=0.8):
     """Overload + chaos drill (ISSUE 13): submit ``burst_factor ×
     queue_depth`` requests back-to-back (arrival far beyond capacity)
     against a service with a bounded admission queue, with ONE injected
@@ -71,20 +123,30 @@ def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
     *under overload* (served tickets only), dispatcher restarts,
     recovery time (first successful completion after the first
     failure), and the hung-ticket count — the acceptance number that
-    MUST be zero.  Pure of argparse/IO so tests call it directly."""
+    MUST be zero.  Pure of argparse/IO so tests call it directly.
+
+    With ``autoscale`` (ISSUE 20) the drill runs against a
+    ``ReplicaSet`` under a deliberately twitchy controller config and
+    the burst becomes a *sustained* pressure window (``pressure_s``) so
+    the controller observes consecutive saturated ticks; the artifact's
+    ``autoscale`` section carries the ordering evidence (scale-out
+    BEFORE any breaker trip; scale-in after recovery)."""
     import jax
     import numpy as np
 
     from gansformer_tpu.obs import registry as telemetry
     from gansformer_tpu.serve import (
-        Cancelled, Expired, GenerationService, Overloaded, ServeError,
-        ServePrograms)
+        Cancelled, Expired, GenerationService, Overloaded, ReplicaSet,
+        ServeError, ServePrograms)
     from gansformer_tpu.supervise import faults
 
     rng = np.random.RandomState(seed)
-    programs = ServePrograms(bundle, buckets=buckets,
-                             manifest_dir=manifest_dir)
-    warm = programs.warm_start()
+    fleet = replicas > 1 or autoscale
+    if not fleet:
+        programs = ServePrograms(bundle, buckets=buckets,
+                                 manifest_dir=manifest_dir,
+                                 serve_precision=serve_precision)
+        warm = programs.warm_start()
     n_req = int(burst_factor * queue_depth)
     seeds = zipf_choice(rng, np.arange(1, seed_universe + 1), n_req,
                         zipf_s)
@@ -123,24 +185,67 @@ def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
         if crash_at_batch:
             faults.arm(faults.parse_specs(
                 f"raise@serve_dispatch:batch={int(crash_at_batch)}"))
-        svc = GenerationService(programs, max_fill_wait_ms=fill_wait_ms,
-                                wcache_capacity=wcache,
-                                max_queue_depth=queue_depth,
-                                default_deadline_s=deadline_s,
-                                restart_backoff_base_s=restart_backoff_s)
+        if fleet:
+            svc = ReplicaSet(
+                bundle, buckets=buckets, manifest_dir=manifest_dir,
+                serve_precision=serve_precision,
+                replicas=replicas, min_replicas=replicas,
+                max_replicas=max_replicas, autoscale=autoscale,
+                # twitchy drill config: the controller must react within
+                # the sub-second pressure window, not on fleet timescales
+                autoscale_interval_s=0.05, scale_out_saturation=0.6,
+                scale_out_ticks=2, scale_in_fill=0.5, scale_in_ticks=4,
+                cooldown_s=0.3,
+                service_kwargs=dict(
+                    max_fill_wait_ms=fill_wait_ms,
+                    wcache_capacity=wcache,
+                    max_queue_depth=queue_depth,
+                    default_deadline_s=deadline_s,
+                    restart_backoff_base_s=restart_backoff_s))
+            warm = svc.warm_start()
+        else:
+            svc = GenerationService(
+                programs, max_fill_wait_ms=fill_wait_ms,
+                wcache_capacity=wcache, max_queue_depth=queue_depth,
+                default_deadline_s=deadline_s,
+                restart_backoff_base_s=restart_backoff_s)
         # Wave 1 — the overload burst: back-to-back submits far beyond
         # capacity; over-bound submissions shed typed.  Capture beats
         # verdict: a breaker tripped by real deaths on sick hardware
         # refuses typed (ServiceUnhealthy) — counted, never raised out
         # of the drill (the artifact must land EXACTLY then).
         refused = 0
-        for i in range(n_req):
-            try:
-                tickets.append(svc.submit(int(seeds[i])))
-            except Overloaded:
-                shed += 1
-            except ServeError:
-                refused += 1
+        burst_submitted = 0
+        if fleet and autoscale:
+            # sustained pressure: a one-shot burst can drain between two
+            # controller ticks on a fast host, so keep the queues
+            # saturated for the whole window (resubmitting the same
+            # Zipf stream); sheds pace the loop so it cannot spin
+            pressure_end = time.perf_counter() + pressure_s
+            i = 0
+            while (time.perf_counter() < pressure_end
+                   or burst_submitted < n_req):
+                try:
+                    tickets.append(svc.submit(int(seeds[i % n_req])))
+                except Overloaded:
+                    shed += 1
+                    time.sleep(0.002)
+                except ServeError:
+                    refused += 1
+                    time.sleep(0.002)
+                i += 1
+                burst_submitted += 1
+                if burst_submitted >= n_req * 64:   # runaway bound
+                    break
+        else:
+            for i in range(n_req):
+                try:
+                    tickets.append(svc.submit(int(seeds[i])))
+                except Overloaded:
+                    shed += 1
+                except ServeError:
+                    refused += 1
+            burst_submitted = n_req
         settle(tickets)
         # Wave 2 — paced recovery traffic: guarantees the dispatcher
         # sees MULTIPLE batches (a small burst can fit one bucket, in
@@ -161,7 +266,18 @@ def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
         tickets += recovery_wave
         settle(recovery_wave)
         recovered = sum(1 for t in recovery_wave if t.state == "done")
+        if fleet and autoscale:
+            # recovery is over (queues empty, batches mostly padding):
+            # wait for the controller to notice and scale back IN —
+            # hysteresis (4 idle ticks @50ms + 0.3s cooldown) bounds
+            # how fast this CAN happen, so poll, don't assert a sleep
+            poll_end = time.perf_counter() + 6.0
+            while time.perf_counter() < poll_end:
+                if any(e["kind"] == "scale_in" for e in svc.events):
+                    break
+                time.sleep(0.05)
         health = svc.health()
+        scale_events = list(svc.events) if fleet else []
     finally:
         if svc is not None:
             svc.close(timeout=grace_s)
@@ -172,18 +288,7 @@ def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
     # have reached a terminal trace event with a cause; a rid still
     # untraced here means a recovery path resolves tickets outside the
     # _resolve funnel (a leak the ledger would never show).
-    from gansformer_tpu.obs import reqtrace as _reqtrace
-
-    rt = _reqtrace.get_reqtracer()
-    rids = [t.rid for t in tickets if getattr(t, "rid", None)]
-    terminal_rows = {r["rid"]: r for r in rt.recent()}
-    missing_terminal = [r for r in rids if r not in terminal_rows]
-    trace_coverage = {
-        "enabled": rt.enabled, "tickets": len(rids),
-        "terminal": sum(1 for r in rids if r in terminal_rows),
-        "missing_terminal_rids": missing_terminal,
-        "ok": not rt.enabled or not missing_terminal,
-    }
+    trace_coverage, terminal_rows = trace_coverage_of(tickets)
     non_fulfilled = [
         {"rid": t.rid, "state": t.state,
          "outcome": (terminal_rows.get(t.rid) or {}).get("outcome"),
@@ -205,22 +310,24 @@ def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
     # None (not NaN — invalid strict JSON) when nothing was served
     lats = sorted(t.latency_ms for t in burst_tickets
                   if t.state == "done")
-    return {
+    result = {
         "mode": "chaos", "buckets": list(buckets),
         "queue_bound": queue_depth, "burst_factor": burst_factor,
         "crash_at_batch": crash_at_batch,
         "deadline_s": deadline_s,
+        "serve_precision": serve_precision,
+        "replicas": replicas,
         # submitted/shed/shed_rate span BOTH waves (burst + recovery),
         # so accepted <= submitted and shed_rate <= 1.0 always hold
-        "submitted": n_req + n_wave2, "burst": n_req,
+        "submitted": burst_submitted + n_wave2, "burst": burst_submitted,
         "accepted": len(tickets), "shed": shed,
         "refused_unhealthy": refused,
-        "shed_rate": round(shed / max(n_req + n_wave2, 1), 4),
+        "shed_rate": round(shed / max(burst_submitted + n_wave2, 1), 4),
         "recovery_wave_served": recovered,
         "served": outcomes["served"], "failed": outcomes["failed"],
         "expired": outcomes["expired"],
         "expired_rate": round(outcomes["expired"]
-                              / max(n_req + n_wave2, 1), 4),
+                              / max(burst_submitted + n_wave2, 1), 4),
         "cancelled": outcomes["cancelled"],
         "hung_tickets": outcomes["hung"],
         "p50_ms_under_overload":
@@ -241,24 +348,56 @@ def run_chaos(bundle, buckets, queue_depth=8, burst_factor=4,
                    "kind": jax.devices()[0].device_kind,
                    "count": len(jax.devices())},
     }
+    if fleet:
+        # the ordering evidence the doctor grades: the LEADING signal
+        # (queue saturation → scale-out) must fire before the TRAILING
+        # one (breaker trip) ever could; scale-in must follow recovery
+        outs = [e["t"] for e in scale_events if e["kind"] == "scale_out"]
+        ins = [e["t"] for e in scale_events if e["kind"] == "scale_in"]
+        trips = [e["t"] for e in scale_events
+                 if e["kind"] == "breaker_trip"]
+        result["autoscale"] = {
+            "enabled": bool(autoscale),
+            "scale_out_fired": len(outs),
+            "scale_in_fired": len(ins),
+            "breaker_trips": len(trips),
+            "scale_out_before_breaker":
+                bool(outs) and (not trips or min(outs) < min(trips)),
+            "scaled_in_after_load": bool(ins),
+            "peak_replicas": max(
+                [e["n_active"] for e in scale_events
+                 if e["kind"] == "scale_out"] + [replicas]),
+            "events": scale_events[-16:],
+        }
+    return result
 
 
 def run_loadtest(bundle, buckets, requests, rate, duration_s,
                  zipf_s=1.1, seed_universe=512, manifest_dir=None,
                  psis=(0.7, 0.5, 1.0, 0.8), fill_wait_ms=2.0,
-                 wcache=4096, seed=0, measure_cold=True):
-    """Drive a GenerationService; returns the result dict (pure of
-    argparse/IO so tests call it directly)."""
+                 wcache=4096, seed=0, measure_cold=True,
+                 serve_precision="f32", replicas=1, autoscale=False,
+                 max_replicas=None, quant_report=False):
+    """Drive the serving floor; returns the result dict (pure of
+    argparse/IO so tests call it directly).  ``replicas > 1`` or
+    ``autoscale`` routes through ``serve.ReplicaSet`` (replica-per-
+    device placement, ISSUE 20) and reports per-replica attribution;
+    ``serve_precision`` selects the synthesis precision axis
+    (f32 | bf16 | int8w); ``quant_report=True`` attaches the AOT
+    cost/fidelity A/B against the f32 reference."""
     import jax
     import numpy as np
 
     from gansformer_tpu.obs import registry as telemetry
-    from gansformer_tpu.serve import GenerationService, ServePrograms
+    from gansformer_tpu.serve import (
+        GenerationService, ReplicaSet, ServePrograms)
 
     rng = np.random.RandomState(seed)
+    fleet = replicas > 1 or autoscale
     result = {"buckets": list(buckets), "zipf_s": zipf_s,
               "seed_universe": seed_universe, "psi_menu": list(psis),
-              "rate_rps": rate,
+              "rate_rps": rate, "serve_precision": serve_precision,
+              "replicas": replicas, "autoscale": bool(autoscale),
               "device": {"platform": jax.devices()[0].platform,
                          "kind": jax.devices()[0].device_kind,
                          "count": len(jax.devices())}}
@@ -274,14 +413,16 @@ def run_loadtest(bundle, buckets, requests, rate, duration_s,
     # -- cold vs warm first image -------------------------------------------
     if measure_cold:
         cold = ServePrograms(bundle, buckets=buckets,
-                             manifest_dir=manifest_dir)
+                             manifest_dir=manifest_dir,
+                             serve_precision=serve_precision)
         t0 = time.perf_counter()
         cold_warmup = cold.warm_start()
         result["cold_build_s"] = round(time.perf_counter() - t0, 3)
         result["cold_first_image_ms"] = round(first_image_ms(cold), 1)
         result["cold_compiles"] = cold_warmup["compiled"]
     programs = ServePrograms(bundle, buckets=buckets,
-                             manifest_dir=manifest_dir)
+                             manifest_dir=manifest_dir,
+                             serve_precision=serve_precision)
     t0 = time.perf_counter()
     warm_stats = programs.warm_start()
     result["warm_build_s"] = round(time.perf_counter() - t0, 3)
@@ -297,6 +438,17 @@ def run_loadtest(bundle, buckets, requests, rate, duration_s,
     result["warm_first_image_total_ms"] = round(
         result["warm_build_s"] * 1000.0 + result["warm_first_image_ms"], 1)
 
+    # -- quantization A/B (opt-in: compiles all three precisions) -----------
+    if quant_report:
+        from gansformer_tpu.serve.quant import cost_report, fidelity_report
+
+        result["quant"] = {
+            "cost": cost_report(bundle, bucket=max(buckets)),
+            "fidelity": {
+                prec: fidelity_report(bundle, prec, bucket=max(buckets))
+                for prec in ("bf16", "int8w")},
+        }
+
     # -- the load run -------------------------------------------------------
     seeds = zipf_choice(rng, np.arange(1, seed_universe + 1), requests,
                         zipf_s)
@@ -305,25 +457,48 @@ def run_loadtest(bundle, buckets, requests, rate, duration_s,
         if rate > 0 else np.zeros(requests)
 
     tickets = []
+    snap0 = telemetry.get_registry().snapshot()
+    peak_replicas = replicas
     t_start = time.perf_counter()
     # the SLO loadtest measures latency under admission, not shedding:
     # the bound sits above the whole request budget so nothing sheds
     # (the overload/chaos mode is run_chaos)
-    with GenerationService(programs, max_fill_wait_ms=fill_wait_ms,
-                           wcache_capacity=wcache,
-                           max_queue_depth=requests + 8) as svc:
+    if fleet:
+        svc = ReplicaSet(
+            bundle, buckets=buckets, manifest_dir=manifest_dir,
+            serve_precision=serve_precision, replicas=replicas,
+            max_replicas=max_replicas, autoscale=autoscale,
+            service_kwargs=dict(max_fill_wait_ms=fill_wait_ms,
+                                wcache_capacity=wcache,
+                                max_queue_depth=requests + 8))
+        svc.warm_start()
+    else:
+        svc = GenerationService(programs, max_fill_wait_ms=fill_wait_ms,
+                                wcache_capacity=wcache,
+                                max_queue_depth=requests + 8)
+    try:
         for i in range(requests):
             if time.perf_counter() - t_start > duration_s:
                 break
             tickets.append(svc.submit(int(seeds[i]),
                                       psi=float(psi_mix[i])))
+            if fleet:
+                peak_replicas = max(peak_replicas, svc.n_active)
             if rate > 0:
                 time.sleep(float(gaps[i]))
         images = [t.result(timeout=max(60.0, duration_s)) for t in tickets]
-    wall_s = time.perf_counter() - t_start
+        wall_s = time.perf_counter() - t_start
+        if fleet:
+            peak_replicas = max(peak_replicas, svc.n_active)
+            ordinals = [r.ordinal for r in svc._replicas]
+    finally:
+        svc.close(timeout=max(60.0, duration_s))
 
     lats = sorted(t.latency_ms for t in tickets)
-    n_chips = len(jax.devices())
+    # chips actually serving, not chips present: a 1-replica run on an
+    # 8-device host used one chip — THE headline the replica-scaling
+    # acceptance reads (img_s_per_chip ~constant as replicas grow)
+    chips_used = peak_replicas if fleet else 1
     snap = telemetry.get_registry().snapshot()
     fill = snap["histograms"].get("serve/batch_fill", {})
     depth = snap["histograms"].get("serve/queue_depth", {})
@@ -338,8 +513,11 @@ def run_loadtest(bundle, buckets, requests, rate, duration_s,
         "p99_ms": round(percentile(lats, 99), 2),
         "mean_ms": round(float(sum(lats)) / max(len(lats), 1), 2),
         "img_per_s": round(len(images) / max(wall_s, 1e-9), 2),
+        "chips_used": chips_used,
+        "img_s_per_chip": round(
+            len(images) / max(wall_s, 1e-9) / max(chips_used, 1), 2),
         "img_per_s_per_chip": round(
-            len(images) / max(wall_s, 1e-9) / n_chips, 2),
+            len(images) / max(wall_s, 1e-9) / len(jax.devices()), 2),
         "batch_fill_mean": round(fill.get("mean", 0.0), 4),
         "queue_depth_mean": round(depth.get("mean", 0.0), 2),
         "queue_depth_max": depth.get("max"),
@@ -349,6 +527,12 @@ def run_loadtest(bundle, buckets, requests, rate, duration_s,
         "synth_dispatch_total": snap["counters"].get(
             "serve/synth_dispatch_total", 0.0),
     })
+    coverage, _ = trace_coverage_of(tickets)
+    result["trace_coverage"] = coverage
+    if fleet:
+        result["peak_replicas"] = peak_replicas
+        result["per_replica"] = per_replica_report(
+            snap0, snap, wall_s, ordinals)
     # request-level drill-down (ISSUE 16): the slowest requests BY ID —
     # the artifact's p99 becomes resolvable to a timeline via
     # `gansformer-telemetry requests <dir> --id <rid>` — plus every
@@ -384,6 +568,24 @@ def main(argv=None) -> int:
     p.add_argument("--fill-wait-ms", type=float, default=2.0)
     p.add_argument("--wcache", type=int, default=4096)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="replica-per-device fleet size (>1 routes "
+                        "through serve.ReplicaSet; needs that many "
+                        "local devices)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="autoscaler upper bound (default: all local "
+                        "devices)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="enable the autoscaler controller (chaos mode "
+                        "runs the scale-out-before-breaker drill)")
+    p.add_argument("--serve-precision", default="f32",
+                   choices=("f32", "bf16", "int8w"),
+                   help="synthesis precision axis: f32 | bf16 "
+                        "(activations) | int8w (bf16 activations + "
+                        "int8 weight-only)")
+    p.add_argument("--quant-report", action="store_true",
+                   help="attach the quantization cost/fidelity A/B "
+                        "(compiles all three precisions — slow)")
     p.add_argument("--chaos", action="store_true",
                    help="overload/chaos drill instead of the SLO "
                         "loadtest: burst past the queue bound with one "
@@ -478,7 +680,9 @@ def main(argv=None) -> int:
             deadline_s=args.deadline_s, zipf_s=args.zipf_s,
             seed_universe=args.seed_universe, manifest_dir=manifest_dir,
             fill_wait_ms=args.fill_wait_ms, wcache=args.wcache,
-            seed=args.seed)
+            seed=args.seed, replicas=args.replicas,
+            autoscale=args.autoscale, max_replicas=args.max_replicas,
+            serve_precision=args.serve_precision)
     else:
         result = run_loadtest(
             bundle, buckets,
@@ -486,7 +690,10 @@ def main(argv=None) -> int:
             duration_s=args.duration_s, zipf_s=args.zipf_s,
             seed_universe=args.seed_universe, manifest_dir=manifest_dir,
             fill_wait_ms=args.fill_wait_ms, wcache=args.wcache,
-            seed=args.seed)
+            seed=args.seed, serve_precision=args.serve_precision,
+            replicas=args.replicas, autoscale=args.autoscale,
+            max_replicas=args.max_replicas,
+            quant_report=args.quant_report)
 
     # telemetry.prom + the schema lint's serve-family check: the SLO
     # histograms must be PRESENT and well-formed, verdict in-artifact

@@ -327,9 +327,9 @@ def _gated_programs(bundle, buckets=(1, 2, 4)):
     gate = threading.Event()
 
     class Gated(ServePrograms):
-        def synthesize(self, ws, psi, rng):
+        def synthesize(self, ws, psi, rng, tags=None):
             gate.wait(20)
-            return super().synthesize(ws, psi, rng)
+            return super().synthesize(ws, psi, rng, tags)
 
     return Gated(bundle, buckets=buckets, manifest_dir=None), gate
 
@@ -611,10 +611,10 @@ def test_bucket_quarantine_reroutes_to_next_larger(bundle):
     from gansformer_tpu.serve import GenerationService, ServePrograms
 
     class FlakyBucket(ServePrograms):
-        def synthesize(self, ws, psi, rng):
+        def synthesize(self, ws, psi, rng, tags=None):
             if ws.shape[0] == 1:
                 raise RuntimeError("bucket-1 executable poisoned")
-            return super().synthesize(ws, psi, rng)
+            return super().synthesize(ws, psi, rng, tags)
 
     q0 = telemetry.counter("serve/bucket_quarantined_total").value
     svc = GenerationService(
